@@ -1,0 +1,15 @@
+"""Test env: force an 8-device virtual CPU mesh before jax is imported.
+
+Mirrors the reference's approach of testing multi-node behavior without a
+cluster (FakeCassandra / minicluster, SURVEY.md §4): we test multi-chip
+sharding on a host-simulated device mesh.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

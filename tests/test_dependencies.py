@@ -1,0 +1,101 @@
+"""Moments / DependencyLink / Dependencies monoid tests
+(reference: zipkin-common DependenciesTest)."""
+
+import math
+import random
+
+from zipkin_tpu.models.dependencies import (
+    Dependencies,
+    DependencyLink,
+    Moments,
+    merge_dependency_links,
+)
+
+
+def test_moments_basic_stats():
+    xs = [2.0, 4.0, 4.0, 4.0, 5.0, 5.0, 7.0, 9.0]
+    m = Moments.of_many(xs)
+    assert m.count == 8
+    assert math.isclose(m.mean, 5.0)
+    assert math.isclose(m.variance, 4.0)
+    assert math.isclose(m.stddev, 2.0)
+
+
+def test_moments_monoid_associative_and_commutative():
+    rng = random.Random(42)
+    xs = [rng.uniform(0, 1000) for _ in range(100)]
+    a = Moments.of_many(xs[:30])
+    b = Moments.of_many(xs[30:70])
+    c = Moments.of_many(xs[70:])
+    whole = Moments.of_many(xs)
+    for combo in [(a + b) + c, a + (b + c), c + b + a]:
+        assert math.isclose(combo.mean, whole.mean, rel_tol=1e-9)
+        assert math.isclose(combo.variance, whole.variance, rel_tol=1e-9)
+        assert combo.count == whole.count
+
+
+def test_moments_central_roundtrip():
+    xs = [1.0, 5.0, 9.0, 14.0, 2.0]
+    m = Moments.of_many(xs)
+    m2 = Moments.from_central(*m.to_central())
+    assert m2 == m
+    n, mean, c2, _, _ = m.to_central()
+    assert n == 5
+    assert math.isclose(mean, m.mean)
+    assert math.isclose(c2 / n, m.variance, rel_tol=1e-9)
+
+
+def test_moments_numerically_stable_for_large_means():
+    # Realistic zipkin durations: mean ~60s (6e7 µs), σ ~1ms (1e3 µs).
+    # Raw power sums would destroy variance/kurtosis here.
+    rng = random.Random(7)
+    xs = [6.0e7 + rng.gauss(0, 1.0e3) for _ in range(20_000)]
+    half = len(xs) // 2
+    m = Moments.of_many(xs[:half]) + Moments.of_many(xs[half:])
+    assert math.isclose(m.mean, sum(xs) / len(xs), rel_tol=1e-12)
+    exact_var = sum((x - sum(xs) / len(xs)) ** 2 for x in xs) / len(xs)
+    assert math.isclose(m.variance, exact_var, rel_tol=1e-6)
+    assert abs(m.skewness) < 0.1
+    assert abs(m.kurtosis) < 0.2
+
+
+def test_moments_skewness_kurtosis_sane():
+    sym = Moments.of_many([1.0, 2.0, 3.0, 4.0, 5.0])
+    assert abs(sym.skewness) < 1e-9
+    skewed = Moments.of_many([1.0, 1.0, 1.0, 10.0])
+    assert skewed.skewness > 0
+
+
+def test_dependency_link_merge():
+    a = DependencyLink("web", "db", Moments.of(10.0))
+    b = DependencyLink("web", "db", Moments.of(20.0))
+    merged = a + b
+    assert merged.duration_moments.count == 2
+    assert math.isclose(merged.duration_moments.mean, 15.0)
+
+
+def test_merge_dependency_links_groups():
+    links = [
+        DependencyLink("web", "db", Moments.of(10.0)),
+        DependencyLink("web", "cache", Moments.of(1.0)),
+        DependencyLink("web", "db", Moments.of(30.0)),
+    ]
+    merged = {(l.parent, l.child): l for l in merge_dependency_links(links)}
+    assert len(merged) == 2
+    assert merged[("web", "db")].duration_moments.count == 2
+
+
+def test_dependencies_monoid():
+    d1 = Dependencies(100, 200, (DependencyLink("a", "b", Moments.of(5.0)),))
+    d2 = Dependencies(150, 400, (DependencyLink("a", "b", Moments.of(7.0)),))
+    total = d1 + d2
+    assert total.start_time == 100
+    assert total.end_time == 400
+    assert len(total.links) == 1
+    assert total.links[0].duration_moments.count == 2
+
+    # zero is the identity
+    z = Dependencies.zero()
+    assert (d1 + z).start_time == d1.start_time
+    assert (z + d1).end_time == d1.end_time
+    assert (d1 + z).links == d1.links

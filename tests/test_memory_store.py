@@ -1,0 +1,15 @@
+"""Run the conformance suite against the in-memory reference store
+(reference: InMemorySpanStoreTest via SpanStoreValidator)."""
+
+import pytest
+
+from zipkin_tpu.store.memory import InMemorySpanStore
+from zipkin_tpu.testing.conformance import (
+    conformance_test_names,
+    run_conformance_test,
+)
+
+
+@pytest.mark.parametrize("name", conformance_test_names())
+def test_memory_store_conformance(name):
+    run_conformance_test(name, InMemorySpanStore)

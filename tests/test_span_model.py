@@ -1,0 +1,107 @@
+"""Span model unit tests (reference: zipkin-common SpanTest/AnnotationTest/EndpointTest)."""
+
+import pytest
+
+from zipkin_tpu.models.span import (
+    Annotation,
+    BinaryAnnotation,
+    Endpoint,
+    Span,
+    merge_by_span_id,
+)
+
+EP_CLIENT = Endpoint(1, 80, "Client")
+EP_SERVER = Endpoint(2, 80, "server")
+
+
+def make_rpc_span():
+    return Span(
+        trace_id=10,
+        name="get",
+        id=20,
+        parent_id=None,
+        annotations=(
+            Annotation(100, "cs", EP_CLIENT),
+            Annotation(150, "sr", EP_SERVER),
+            Annotation(190, "ss", EP_SERVER),
+            Annotation(200, "cr", EP_CLIENT),
+        ),
+    )
+
+
+def test_service_name_prefers_server_side():
+    assert make_rpc_span().service_name == "server"
+
+
+def test_service_name_falls_back_to_client():
+    span = Span(1, "x", 2, annotations=(Annotation(5, "cs", EP_CLIENT),))
+    assert span.service_name == "Client"
+
+
+def test_service_names_lowercased():
+    assert make_rpc_span().service_names == {"client", "server"}
+
+
+def test_duration_and_first_last():
+    span = make_rpc_span()
+    assert span.first_timestamp == 100
+    assert span.last_timestamp == 200
+    assert span.duration == 100
+
+
+def test_duration_none_without_annotations():
+    assert Span(1, "x", 2).duration is None
+
+
+def test_is_valid_rejects_duplicate_core_annotations():
+    span = make_rpc_span()
+    assert span.is_valid()
+    bad = Span(
+        1, "x", 2, annotations=(Annotation(1, "cs", None), Annotation(2, "cs", None))
+    )
+    assert not bad.is_valid()
+
+
+def test_merge_combines_halves():
+    client = Span(
+        1,
+        "get",
+        2,
+        annotations=(Annotation(100, "cs", EP_CLIENT), Annotation(200, "cr", EP_CLIENT)),
+        binary_annotations=(BinaryAnnotation("k", b"v"),),
+    )
+    server = Span(
+        1,
+        "",
+        2,
+        annotations=(Annotation(150, "sr", EP_SERVER), Annotation(190, "ss", EP_SERVER)),
+        debug=True,
+    )
+    merged = server.merge(client)
+    assert merged.name == "get"  # empty name replaced
+    assert len(merged.annotations) == 4
+    assert len(merged.binary_annotations) == 1
+    assert merged.debug
+
+
+def test_merge_rejects_mismatched_ids():
+    with pytest.raises(ValueError):
+        Span(1, "a", 2).merge(Span(1, "a", 3))
+
+
+def test_merge_by_span_id():
+    a = Span(1, "a", 2, annotations=(Annotation(1, "cs", EP_CLIENT),))
+    b = Span(1, "", 2, annotations=(Annotation(2, "sr", EP_SERVER),))
+    c = Span(1, "c", 3, annotations=(Annotation(3, "cs", EP_CLIENT),))
+    merged = merge_by_span_id([a, b, c])
+    assert len(merged) == 2
+    assert len(merged[0].annotations) == 2
+
+
+def test_is_client_side():
+    assert Span(1, "x", 2, annotations=(Annotation(1, "cs", None),)).is_client_side()
+    assert not Span(1, "x", 2, annotations=(Annotation(1, "sr", None),)).is_client_side()
+
+
+def test_endpoint_ipv4_str():
+    assert Endpoint(0x7F000001, 80, "s").ipv4_str() == "127.0.0.1"

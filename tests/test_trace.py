@@ -1,0 +1,92 @@
+"""Trace assembly tests (reference: zipkin-common TraceTest)."""
+
+from zipkin_tpu.models.span import Annotation, Endpoint, Span
+from zipkin_tpu.models.trace import Trace, TraceCombo, TraceSummary
+
+EP = Endpoint(1, 80, "svc")
+
+
+def ann(ts, value, ep=EP):
+    return Annotation(ts, value, ep)
+
+
+def make_trace():
+    root = Span(1, "root", 100, None, (ann(100, "sr"), ann(500, "ss")))
+    child1 = Span(1, "c1", 200, 100, (ann(150, "sr"), ann(200, "ss")))
+    child2 = Span(1, "c2", 300, 100, (ann(250, "sr"), ann(300, "ss")))
+    grandchild = Span(1, "g", 400, 300, (ann(260, "sr"), ann(280, "ss")))
+    # shuffled input order; Trace must sort by first timestamp
+    return Trace([child2, grandchild, root, child1])
+
+
+def test_spans_sorted_by_first_timestamp():
+    t = make_trace()
+    assert [s.name for s in t.spans] == ["root", "c1", "c2", "g"]
+
+
+def test_trace_id_and_root():
+    t = make_trace()
+    assert t.id == 1
+    assert t.get_root_span().name == "root"
+    assert t.get_root_most_span().name == "root"
+
+
+def test_root_most_span_with_missing_root():
+    orphan = Span(1, "orphan", 200, 999, (ann(150, "sr"),))
+    child = Span(1, "child", 300, 200, (ann(160, "sr"),))
+    t = Trace([orphan, child])
+    assert t.get_root_most_span().name == "orphan"
+
+
+def test_duration_and_timespan():
+    t = make_trace()
+    assert t.start_and_end_timestamp() == (100, 500)
+    assert t.duration == 400
+
+
+def test_span_depths():
+    depths = make_trace().to_span_depths()
+    assert depths == {100: 1, 200: 2, 300: 2, 400: 3}
+
+
+def test_services_and_counts():
+    t = make_trace()
+    assert t.services == {"svc"}
+    assert t.service_counts() == {"svc": 4}
+
+
+def test_merges_split_spans():
+    client = Span(1, "rpc", 7, None, (ann(10, "cs"), ann(40, "cr")))
+    server = Span(1, "rpc", 7, None, (ann(20, "sr"), ann(30, "ss")))
+    t = Trace([client, server])
+    assert len(t.spans) == 1
+    assert len(t.spans[0].annotations) == 4
+
+
+def test_summary_and_combo():
+    t = make_trace()
+    s = TraceSummary.from_trace(t)
+    assert s.trace_id == 1
+    assert s.duration_micro == 400
+    combo = TraceCombo.from_trace(t)
+    assert combo.summary == s
+    assert combo.timeline.root_span_id == 100
+    assert combo.timeline.annotations[0].timestamp == 100
+    assert combo.span_depths[400] == 3
+
+
+def test_empty_trace():
+    t = Trace([])
+    assert t.id is None
+    assert t.get_root_span() is None
+    assert t.duration == 0
+    assert TraceSummary.from_trace(t) is None
+
+
+def test_parent_id_cycle_does_not_recurse_forever():
+    # Malformed input: two spans that are each other's parent.
+    a = Span(9, "a", 1, 2, (ann(1, "sr"),))
+    b = Span(9, "b", 2, 1, (ann(2, "sr"),))
+    t = Trace([a, b])
+    assert t.get_root_most_span().name == "a"
+    assert t.to_span_depths() == {1: 1, 2: 2}

@@ -1,0 +1,1 @@
+"""Data model: spans, traces, dependency links, and the columnar schema."""

@@ -1,0 +1,11 @@
+"""Pluggable span storage: SPI + in-memory reference + TPU columnar store."""
+
+from zipkin_tpu.store.base import (  # noqa: F401
+    IndexedTraceId,
+    ReadSpanStore,
+    SpanStore,
+    StorageException,
+    TraceIdDuration,
+    WriteSpanStore,
+)
+from zipkin_tpu.store.memory import InMemorySpanStore  # noqa: F401

@@ -1,0 +1,141 @@
+"""SpanStore SPI.
+
+Parity targets (reference):
+- ``SpanStore = WriteSpanStore with ReadSpanStore`` —
+  zipkin-common/.../storage/SpanStore.scala:26,56,71
+- ``IndexedTraceId`` / ``TraceIdDuration`` — storage/Index.scala:29,26
+- ``FanoutWriteSpanStore`` — SpanStore.scala:38
+
+The API is array-friendly: every read returns plain python data, every write
+takes a batch of spans; implementations may be host-resident (memory) or
+device-resident (TPU columnar + sketches). Synchronous by design — the
+async boundary in this framework lives in the ingest queue
+(zipkin_tpu.ingest.queue), not in the store.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Set
+
+from zipkin_tpu.models.span import Span
+
+# Reference default TTLs (CassieSpanStore.scala:47-48).
+DEFAULT_SPAN_TTL_S = 7 * 24 * 3600
+DEFAULT_INDEX_TTL_S = 3 * 24 * 3600
+TTL_TOP = float("inf")
+
+
+class StorageException(RuntimeError):
+    """Raised by stores on write/read failure (storage/util SpanStoreException)."""
+
+
+@dataclass(frozen=True)
+class IndexedTraceId:
+    """A trace id with the index timestamp that matched (Index.scala:29)."""
+
+    trace_id: int
+    timestamp: int
+
+
+@dataclass(frozen=True)
+class TraceIdDuration:
+    """Trace duration in µs + start timestamp (Index.scala:26)."""
+
+    trace_id: int
+    duration: int
+    start_timestamp: int
+
+
+def should_index(span: Span) -> bool:
+    """Skip indexing client-side spans attributed to the literal service
+    "client" (SpanStore.scala:66-67)."""
+    return not (span.is_client_side() and "client" in span.service_names)
+
+
+class WriteSpanStore(abc.ABC):
+    @abc.abstractmethod
+    def apply(self, spans: Sequence[Span]) -> None:
+        """Store a batch of spans."""
+
+    @abc.abstractmethod
+    def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
+        """Pin/extend a trace's retention (SpanStore.scala:66)."""
+
+    def close(self) -> None:
+        pass
+
+
+class ReadSpanStore(abc.ABC):
+    @abc.abstractmethod
+    def get_time_to_live(self, trace_id: int) -> float:
+        ...
+
+    @abc.abstractmethod
+    def traces_exist(self, trace_ids: Sequence[int]) -> Set[int]:
+        ...
+
+    @abc.abstractmethod
+    def get_spans_by_trace_ids(self, trace_ids: Sequence[int]) -> List[List[Span]]:
+        """Found traces only; absent ids are dropped from the result."""
+
+    def get_spans_by_trace_id(self, trace_id: int) -> List[Span]:
+        found = self.get_spans_by_trace_ids([trace_id])
+        return found[0] if found else []
+
+    @abc.abstractmethod
+    def get_trace_ids_by_name(
+        self,
+        service_name: str,
+        span_name: Optional[str],
+        end_ts: int,
+        limit: int,
+    ) -> List[IndexedTraceId]:
+        ...
+
+    @abc.abstractmethod
+    def get_trace_ids_by_annotation(
+        self,
+        service_name: str,
+        annotation: str,
+        value: Optional[bytes],
+        end_ts: int,
+        limit: int,
+    ) -> List[IndexedTraceId]:
+        ...
+
+    @abc.abstractmethod
+    def get_traces_duration(self, trace_ids: Sequence[int]) -> List[TraceIdDuration]:
+        ...
+
+    @abc.abstractmethod
+    def get_all_service_names(self) -> Set[str]:
+        ...
+
+    @abc.abstractmethod
+    def get_span_names(self, service: str) -> Set[str]:
+        ...
+
+
+class SpanStore(WriteSpanStore, ReadSpanStore, abc.ABC):
+    """The unified store interface (SpanStore.scala:26)."""
+
+
+class FanoutWriteSpanStore(WriteSpanStore):
+    """Replicate writes to several stores (SpanStore.scala:38)."""
+
+    def __init__(self, *stores: WriteSpanStore):
+        self.stores = stores
+
+    def apply(self, spans: Sequence[Span]) -> None:
+        for s in self.stores:
+            s.apply(spans)
+
+    def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
+        for s in self.stores:
+            s.set_time_to_live(trace_id, ttl_seconds)
+
+    def close(self) -> None:
+        for s in self.stores:
+            s.close()

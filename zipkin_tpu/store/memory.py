@@ -1,0 +1,164 @@
+"""In-memory reference SpanStore.
+
+Parity target: ``InMemorySpanStore`` (zipkin-common/.../storage/SpanStore.scala:128).
+This is the correctness oracle the conformance suite and the TPU store are
+checked against. Deliberate deviations from the reference's *in-memory*
+store, each matching what its *real* backends (Cassandra/anormdb) do
+instead:
+
+- indexed-id results are sorted by timestamp descending before the limit
+  is applied (the reference in-memory store truncates in insertion order);
+- binary-annotation *keys* match annotation queries even without a value
+  (Cassandra writes AnnotationsIndex rows for binary-annotation keys,
+  CassieSpanStore.scala:168-251);
+- the end_ts filter compares the span's last timestamp uniformly (the
+  reference in-memory store mixes first/last between the two paths);
+- empty span names and empty service names are not indexed
+  (CassieSpanStore skips them on write).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Set
+
+from zipkin_tpu.models.constants import CORE_ANNOTATIONS
+from zipkin_tpu.models.span import Span
+from zipkin_tpu.store.base import (
+    IndexedTraceId,
+    SpanStore,
+    TraceIdDuration,
+    should_index,
+)
+
+
+class InMemorySpanStore(SpanStore):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.spans: List[Span] = []
+        self.ttls: Dict[int, float] = {}
+
+    # -- writes ---------------------------------------------------------
+
+    def apply(self, spans: Sequence[Span]) -> None:
+        with self._lock:
+            for span in spans:
+                self.ttls[span.trace_id] = 1.0
+            self.spans.extend(spans)
+
+    def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
+        with self._lock:
+            self.ttls[trace_id] = ttl_seconds
+
+    # -- reads (all under the same lock as writes, like the reference's
+    #    synchronized `call`, SpanStore.scala:131) ------------------------
+
+    def get_time_to_live(self, trace_id: int) -> float:
+        with self._lock:
+            return self.ttls[trace_id]
+
+    def traces_exist(self, trace_ids: Sequence[int]) -> Set[int]:
+        with self._lock:
+            present = {s.trace_id for s in self.spans}
+        return present & set(trace_ids)
+
+    def get_spans_by_trace_ids(self, trace_ids: Sequence[int]) -> List[List[Span]]:
+        with self._lock:
+            snapshot = list(self.spans)
+        out = []
+        for tid in trace_ids:
+            found = [s for s in snapshot if s.trace_id == tid]
+            if found:
+                out.append(found)
+        return out
+
+    def _spans_for_service(self, name: str) -> List[Span]:
+        name = name.lower()
+        with self._lock:
+            snapshot = list(self.spans)
+        return [s for s in snapshot if should_index(s) and name in s.service_names]
+
+    def get_trace_ids_by_name(
+        self,
+        service_name: str,
+        span_name: Optional[str],
+        end_ts: int,
+        limit: int,
+    ) -> List[IndexedTraceId]:
+        matched = self._spans_for_service(service_name)
+        if span_name is not None:
+            wanted = span_name.lower()
+            matched = [s for s in matched if s.name.lower() == wanted]
+        matched = [
+            s
+            for s in matched
+            if s.last_timestamp is not None and s.last_timestamp <= end_ts
+        ]
+        matched.sort(key=lambda s: s.last_timestamp, reverse=True)
+        return [
+            IndexedTraceId(s.trace_id, s.last_timestamp) for s in matched[:limit]
+        ]
+
+    def get_trace_ids_by_annotation(
+        self,
+        service_name: str,
+        annotation: str,
+        value: Optional[bytes],
+        end_ts: int,
+        limit: int,
+    ) -> List[IndexedTraceId]:
+        # Core annotations are not indexed (SpanStore.scala:199).
+        if annotation in CORE_ANNOTATIONS:
+            return []
+        candidates = self._spans_for_service(service_name)
+        matched = []
+        for s in candidates:
+            if s.last_timestamp is None or s.last_timestamp > end_ts:
+                continue
+            if value is not None:
+                ok = any(
+                    b.key == annotation and _as_bytes(b.value) == value
+                    for b in s.binary_annotations
+                )
+            else:
+                ok = any(a.value == annotation for a in s.annotations) or any(
+                    b.key == annotation for b in s.binary_annotations
+                )
+            if ok:
+                matched.append(s)
+        matched.sort(key=lambda s: s.last_timestamp, reverse=True)
+        return [
+            IndexedTraceId(s.trace_id, s.last_timestamp) for s in matched[:limit]
+        ]
+
+    def get_traces_duration(self, trace_ids: Sequence[int]) -> List[TraceIdDuration]:
+        with self._lock:
+            snapshot = list(self.spans)
+        out = []
+        for tid in trace_ids:
+            ts = []
+            for s in snapshot:
+                if s.trace_id == tid:
+                    if s.first_timestamp is not None:
+                        ts.append(s.first_timestamp)
+                    if s.last_timestamp is not None:
+                        ts.append(s.last_timestamp)
+            if ts:
+                out.append(TraceIdDuration(tid, max(ts) - min(ts), min(ts)))
+        return out
+
+    def get_all_service_names(self) -> Set[str]:
+        with self._lock:
+            snapshot = list(self.spans)
+        return {n for s in snapshot for n in s.service_names if n}
+
+    def get_span_names(self, service: str) -> Set[str]:
+        return {s.name for s in self._spans_for_service(service) if s.name}
+
+
+def _as_bytes(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    return bytes(v)

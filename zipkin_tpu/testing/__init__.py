@@ -1,0 +1,1 @@
+"""Reusable test fixtures: the store conformance suite and span builders."""
