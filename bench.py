@@ -1,0 +1,92 @@
+"""Ingest throughput benchmark (BASELINE.md config #2 scaled to runtime).
+
+Streams ColumnarTraceGen batches through the fused device ingest_step
+and reports spans/sec, compared against the reference-shaped CPU path
+(python object spans → InMemorySpanStore.apply — the in-process
+analogue of the JVM collector's hot write path).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_tpu_ingest(total_spans: int = 2_000_000, batch_traces: int = 8192):
+    import jax
+    import numpy as np
+
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import ColumnarTraceGen
+
+    config = dev.StoreConfig(
+        capacity=1 << 20, ann_capacity=1 << 21, bann_capacity=1 << 20,
+        max_services=256, max_span_names=1024, max_annotation_values=2048,
+        max_binary_keys=256, cms_width=1 << 16, hll_p=14,
+        quantile_buckets=1024,
+    )
+    store = TpuSpanStore(config)
+    gen = ColumnarTraceGen(store.dicts, n_services=256, n_span_names=1024,
+                           spans_per_trace=7)
+    spt = gen.spans_per_trace
+    pad_spans = batch_traces * spt
+    # Pre-generate a rotation of host batches so generation cost doesn't
+    # pollute the device measurement.
+    dbs = []
+    for _ in range(4):
+        batch, name_lc, indexable = gen.next_batch(batch_traces)
+        dbs.append(dev.make_device_batch(
+            batch, name_lc, indexable,
+            pad_spans=pad_spans, pad_anns=2 * pad_spans, pad_banns=pad_spans,
+        ))
+    state = store.state
+    # Warmup/compile.
+    state = dev.ingest_step(state, dbs[0])
+    jax.block_until_ready(state.counters["spans_seen"])
+
+    n_steps = max(1, total_spans // pad_spans)
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        state = dev.ingest_step(state, dbs[i % len(dbs)])
+    jax.block_until_ready(state.counters["spans_seen"])
+    dt = time.perf_counter() - t0
+    return (n_steps * pad_spans) / dt
+
+
+def bench_cpu_reference(total_spans: int = 20_000):
+    from zipkin_tpu.store.memory import InMemorySpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    traces = generate_traces(n_traces=max(1, total_spans // 20), max_depth=5)
+    spans = [s for t in traces for s in t][:total_spans]
+    store = InMemorySpanStore()
+    t0 = time.perf_counter()
+    for i in range(0, len(spans), 500):
+        store.apply(spans[i:i + 500])
+    dt = time.perf_counter() - t0
+    return len(spans) / dt
+
+
+def main():
+    import sys
+
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        tpu_rate = bench_tpu_ingest(total_spans=200_000, batch_traces=1024)
+        cpu_rate = bench_cpu_reference(total_spans=2_000)
+    else:
+        tpu_rate = bench_tpu_ingest()
+        cpu_rate = bench_cpu_reference()
+    print(json.dumps({
+        "metric": "ingest_throughput",
+        "value": round(tpu_rate, 1),
+        "unit": "spans/sec",
+        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

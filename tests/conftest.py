@@ -1,15 +1,23 @@
-"""Test env: force an 8-device virtual CPU mesh before jax is imported.
+"""Test env: force an 8-device virtual CPU mesh before jax is used.
 
 Mirrors the reference's approach of testing multi-node behavior without a
 cluster (FakeCassandra / minicluster, SURVEY.md §4): we test multi-chip
 sharding on a host-simulated device mesh.
+
+The environment may pre-register an accelerator backend (and pre-set
+JAX_PLATFORMS) via sitecustomize, so setting env vars is not enough —
+we also flip the config explicitly before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

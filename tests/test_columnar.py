@@ -1,0 +1,172 @@
+"""Columnar codec tests: dictionary encoding + Span↔SpanBatch roundtrip."""
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.columnar import (
+    FLAG_DEBUG,
+    FLAG_HAS_PARENT,
+    NO_SERVICE,
+    NO_TS,
+    SpanBatch,
+    SpanCodec,
+)
+from zipkin_tpu.columnar.dictionary import Dictionary, DictionarySet
+from zipkin_tpu.columnar.encode import to_signed64
+from zipkin_tpu.models.constants import CORE_ANNOTATION_IDS, FIRST_USER_ANNOTATION_ID
+from zipkin_tpu.models.span import (
+    Annotation,
+    AnnotationType,
+    BinaryAnnotation,
+    Endpoint,
+    Span,
+)
+
+EP_WEB = Endpoint(ipv4=0x7F000001, port=80, service_name="web")
+EP_DB = Endpoint(ipv4=0x7F000002, port=5432, service_name="db")
+
+
+def make_span(trace_id=1, span_id=100, parent=None, name="get", debug=False):
+    return Span(
+        trace_id=trace_id,
+        name=name,
+        id=span_id,
+        parent_id=parent,
+        annotations=(
+            Annotation(1000, "cs", EP_WEB),
+            Annotation(1500, "custom-event", EP_WEB),
+            Annotation(2000, "cr", EP_WEB),
+        ),
+        binary_annotations=(
+            BinaryAnnotation("http.uri", "/widgets", AnnotationType.STRING, EP_WEB),
+            BinaryAnnotation("payload", b"\x00\x01", AnnotationType.BYTES, None),
+        ),
+        debug=debug,
+    )
+
+
+class TestDictionary:
+    def test_dense_ids_first_seen_order(self):
+        d = Dictionary()
+        assert d.encode("a") == 0
+        assert d.encode("b") == 1
+        assert d.encode("a") == 0
+        assert d.decode(1) == "b"
+        assert len(d) == 2
+
+    def test_reserved_ids(self):
+        d = Dictionary(reserved={"cs": 0, "sa": 5})
+        assert d.encode("cs") == 0
+        assert d.encode("sa") == 5
+        assert d.encode("new") == 6
+
+    def test_get_without_assign(self):
+        d = Dictionary()
+        assert d.get("missing") is None
+        assert len(d) == 0
+
+    def test_core_annotation_ids_reserved(self):
+        ds = DictionarySet()
+        for value, vid in CORE_ANNOTATION_IDS.items():
+            assert ds.annotations.encode(value) == vid
+        assert ds.annotations.encode("userann") >= FIRST_USER_ANNOTATION_ID
+
+
+class TestSigned64:
+    def test_roundtrip_boundaries(self):
+        for x in (0, 1, -1, 2**63 - 1, -(2**63)):
+            assert to_signed64(x) == x
+        assert to_signed64(2**63) == -(2**63)
+        assert to_signed64(2**64 - 1) == -1
+
+
+class TestCodecRoundtrip:
+    def test_roundtrip_lossless(self):
+        spans = [
+            make_span(trace_id=1, span_id=100, parent=None, debug=True),
+            make_span(trace_id=1, span_id=101, parent=100, name="child"),
+            make_span(trace_id=-5, span_id=-7, parent=-9),
+            Span(trace_id=2, name="bare", id=3),  # no annotations at all
+        ]
+        codec = SpanCodec()
+        batch = codec.encode(spans)
+        assert batch.n_spans == 4
+        decoded = codec.decode(batch)
+        assert decoded == spans
+
+    def test_core_ts_columns(self):
+        codec = SpanCodec()
+        b = codec.encode(
+            [
+                Span(
+                    trace_id=1,
+                    name="rpc",
+                    id=2,
+                    annotations=(
+                        Annotation(10, "cs", EP_WEB),
+                        Annotation(12, "sr", EP_DB),
+                        Annotation(18, "ss", EP_DB),
+                        Annotation(20, "cr", EP_WEB),
+                    ),
+                )
+            ]
+        )
+        assert b.ts_cs[0] == 10 and b.ts_sr[0] == 12
+        assert b.ts_ss[0] == 18 and b.ts_cr[0] == 20
+        assert b.ts_first[0] == 10 and b.ts_last[0] == 20
+        assert b.duration[0] == 10
+
+    def test_missing_fields_sentinels(self):
+        codec = SpanCodec()
+        b = codec.encode([Span(trace_id=1, name="bare", id=3)])
+        assert b.ts_cs[0] == NO_TS and b.duration[0] == NO_TS
+        assert b.service_id[0] == NO_SERVICE
+        assert not (b.flags[0] & FLAG_HAS_PARENT)
+
+    def test_flags(self):
+        codec = SpanCodec()
+        b = codec.encode([make_span(debug=True, parent=99)])
+        assert b.flags[0] & FLAG_DEBUG
+        assert b.flags[0] & FLAG_HAS_PARENT
+        assert b.parent_id[0] == 99
+
+    def test_service_id_is_owning_service_lowercased(self):
+        ep = Endpoint(service_name="WEB")
+        codec = SpanCodec()
+        b = codec.encode(
+            [Span(trace_id=1, name="x", id=1, annotations=(Annotation(1, "sr", ep),))]
+        )
+        assert codec.dicts.services.decode(int(b.service_id[0])) == "web"
+
+    def test_shared_dictionaries_across_batches(self):
+        codec = SpanCodec()
+        b1 = codec.encode([make_span(trace_id=1)])
+        b2 = codec.encode([make_span(trace_id=2)])
+        assert b1.name_id[0] == b2.name_id[0]
+        assert b1.service_id[0] == b2.service_id[0]
+
+
+class TestBatchOps:
+    def test_concat_rebases_span_idx(self):
+        codec = SpanCodec()
+        b1 = codec.encode([make_span(trace_id=1, span_id=1)])
+        b2 = codec.encode([make_span(trace_id=2, span_id=2)])
+        cat = b1.concat(b2)
+        assert cat.n_spans == 2
+        assert cat.n_annotations == b1.n_annotations + b2.n_annotations
+        assert set(cat.ann_span_idx[-3:]) == {1}
+        assert codec.decode(cat) == codec.decode(b1) + codec.decode(b2)
+
+    def test_select_mask_and_indices(self):
+        codec = SpanCodec()
+        spans = [make_span(trace_id=t, span_id=t * 10) for t in (1, 2, 3)]
+        batch = codec.encode(spans)
+        sub = batch.select(np.array([True, False, True]))
+        assert codec.decode(sub) == [spans[0], spans[2]]
+        sub2 = batch.select(np.array([2, 0]))
+        assert codec.decode(sub2) == [spans[2], spans[0]]
+
+    def test_empty(self):
+        b = SpanBatch.empty()
+        assert b.n_spans == 0 and b.n_annotations == 0 and b.n_binary == 0
+        assert SpanCodec().decode(b) == []

@@ -1,0 +1,233 @@
+"""Sketch-kernel tests: hashing, moments, count-min, HLL, quantile, top-k.
+
+Runs on the CPU backend (conftest) — the same jitted code paths the TPU
+executes. Accuracy bounds asserted are the sketches' theoretical
+guarantees, not tuned-to-pass tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zipkin_tpu.models.dependencies import Moments
+from zipkin_tpu.ops import cms, hll
+from zipkin_tpu.ops import moments as M
+from zipkin_tpu.ops import quantile as Q
+from zipkin_tpu.ops import topk
+from zipkin_tpu.ops.hashing import clz32, fmix32, hash2_32, join64, split64
+
+
+class TestHashing:
+    def test_split_join_roundtrip(self):
+        xs = np.array([0, 1, -1, 2**63 - 1, -(2**63), 123456789012345], np.int64)
+        hi, lo = split64(xs)
+        assert hi.dtype == np.uint32 and lo.dtype == np.uint32
+        np.testing.assert_array_equal(join64(hi, lo), xs)
+
+    def test_fmix32_avalanche(self):
+        xs = jnp.arange(1, 10000, dtype=jnp.uint32)
+        hs = np.asarray(fmix32(xs))
+        assert len(np.unique(hs)) == len(hs)  # bijective on a small range
+        # roughly half the bits set on average
+        bits = np.unpackbits(hs.view(np.uint8)).mean()
+        assert 0.45 < bits < 0.55
+
+    def test_hash2_seed_independence(self):
+        hi = jnp.zeros(1000, jnp.uint32)
+        lo = jnp.arange(1000, dtype=jnp.uint32)
+        h0 = np.asarray(hash2_32(hi, lo, 0))
+        h1 = np.asarray(hash2_32(hi, lo, 1))
+        assert (h0 != h1).mean() > 0.99
+        # low bits well distributed: with 1000 draws over 256 buckets,
+        # E[missing] = 256*(255/256)^1000 ~ 5
+        assert len(np.unique(h0 & 255)) > 235
+
+    def test_hash_uses_both_words(self):
+        lo = jnp.arange(1000, dtype=jnp.uint32)
+        a = np.asarray(hash2_32(jnp.zeros(1000, jnp.uint32), lo, 7))
+        b = np.asarray(hash2_32(jnp.ones(1000, jnp.uint32), lo, 7))
+        assert (a != b).mean() > 0.99
+
+    def test_clz32(self):
+        xs = jnp.array([0, 1, 2, 3, 255, 256, 2**31, 2**32 - 1], jnp.uint32)
+        np.testing.assert_array_equal(
+            np.asarray(clz32(xs)), [32, 31, 30, 30, 24, 23, 0, 0]
+        )
+
+
+class TestMoments:
+    def test_combine_matches_host_moments(self):
+        rng = np.random.default_rng(0)
+        xs = rng.lognormal(8.0, 1.0, size=256).astype(np.float32)
+        host = Moments.of_many(float(x) for x in xs)
+        dev = jax.jit(lambda v: M.reduce_moments(M.of(v)))(jnp.asarray(xs))
+        got = np.asarray(dev, np.float64)
+        assert got[0] == pytest.approx(host.n)
+        assert got[1] == pytest.approx(host.mean, rel=1e-5)
+        assert got[2] == pytest.approx(host.m2, rel=1e-3)
+        assert got[3] == pytest.approx(host.m3, rel=1e-2, abs=1e-2 * abs(host.m4))
+        assert got[4] == pytest.approx(host.m4, rel=1e-2)
+
+    def test_combine_zero_identity(self):
+        m = M.of(jnp.asarray(5.0))
+        np.testing.assert_allclose(np.asarray(M.combine(m, M.zero())), np.asarray(m))
+        np.testing.assert_allclose(np.asarray(M.combine(M.zero(), m)), np.asarray(m))
+
+    def test_segment_moments_exact(self):
+        values = jnp.asarray([10.0, 20.0, 30.0, 100.0, 5.0])
+        seg = jnp.asarray([0, 0, 0, 1, 2])
+        out = np.asarray(M.segment_moments(values, seg, 4), np.float64)
+        ref0 = Moments.of_many([10.0, 20.0, 30.0])
+        assert out[0][0] == 3 and out[0][1] == pytest.approx(ref0.mean)
+        assert out[0][2] == pytest.approx(ref0.m2, rel=1e-5)
+        assert out[1][0] == 1 and out[1][1] == 100.0
+        assert out[3][0] == 0  # untouched segment
+
+    def test_segment_moments_mask(self):
+        values = jnp.asarray([10.0, 999.0, 20.0])
+        seg = jnp.asarray([0, 0, 0])
+        valid = jnp.asarray([True, False, True])
+        out = np.asarray(M.segment_moments(values, seg, 1, valid=valid))
+        assert out[0][0] == 2
+        assert out[0][1] == pytest.approx(15.0)
+
+
+class TestCountMin:
+    def test_exact_when_sparse(self):
+        keys = np.arange(100, dtype=np.int64) * 7919
+        hi, lo = split64(keys)
+        sk = cms.init(depth=4, width=1 << 12)
+        sk = jax.jit(cms.update)(sk, hi, lo)
+        est = np.asarray(cms.query(sk, jnp.asarray(hi), jnp.asarray(lo)))
+        np.testing.assert_array_equal(est, np.ones(100))
+
+    def test_never_underestimates(self):
+        rng = np.random.default_rng(1)
+        keys = rng.integers(-(2**62), 2**62, size=5000, dtype=np.int64)
+        true = {}
+        for k in keys:
+            true[k] = true.get(k, 0) + 1
+        hi, lo = split64(keys)
+        sk = cms.update(cms.init(depth=4, width=1 << 10), hi, lo)
+        uniq = np.array(list(true), np.int64)
+        uh, ul = split64(uniq)
+        est = np.asarray(cms.query(sk, uh, ul))
+        want = np.array([true[k] for k in uniq])
+        assert (est >= want).all()
+        # CMS guarantee: err <= e*N/width with prob 1-e^-depth; check mean err
+        assert (est - want).mean() < np.e * len(keys) / (1 << 10)
+
+    def test_weights_and_merge(self):
+        keys = np.array([42, 43], np.int64)
+        hi, lo = split64(keys)
+        a = cms.update(cms.init(), hi, lo, weights=jnp.asarray([5, 3]))
+        b = cms.update(cms.init(), hi, lo, weights=jnp.asarray([1, 2]))
+        m = cms.merge(a, b)
+        np.testing.assert_array_equal(np.asarray(cms.query(m, hi, lo)), [6, 5])
+        assert int(cms.total(m)) == 11
+
+    def test_duplicate_keys_in_batch(self):
+        keys = np.array([7, 7, 7, 9], np.int64)
+        hi, lo = split64(keys)
+        sk = cms.update(cms.init(), hi, lo)
+        est = np.asarray(cms.query(sk, *split64(np.array([7, 9], np.int64))))
+        np.testing.assert_array_equal(est, [3, 1])
+
+
+class TestHLL:
+    @pytest.mark.parametrize("n", [100, 10_000, 200_000])
+    def test_cardinality_within_error(self, n):
+        keys = np.arange(n, dtype=np.int64) * 2654435761 + 17
+        hi, lo = split64(keys)
+        sk = jax.jit(hll.update)(hll.init(), hi, lo)
+        est = float(hll.estimate(sk))
+        # 1.04/sqrt(2^14) ~ 0.8%; allow 4 sigma
+        assert abs(est - n) / n < 0.033
+
+    def test_duplicates_do_not_inflate(self):
+        keys = np.tile(np.arange(1000, dtype=np.int64), 50)
+        hi, lo = split64(keys)
+        sk = hll.update(hll.init(), hi, lo)
+        assert abs(float(hll.estimate(sk)) - 1000) / 1000 < 0.05
+
+    def test_merge_is_union(self):
+        a_keys = np.arange(0, 30_000, dtype=np.int64)
+        b_keys = np.arange(15_000, 45_000, dtype=np.int64)  # 50% overlap
+        a = hll.update(hll.init(), *split64(a_keys))
+        b = hll.update(hll.init(), *split64(b_keys))
+        est = float(hll.estimate(hll.merge(a, b)))
+        assert abs(est - 45_000) / 45_000 < 0.033
+
+    def test_empty(self):
+        assert float(hll.estimate(hll.init())) == 0.0
+
+
+class TestLogHistogram:
+    def test_relative_error_guarantee(self):
+        rng = np.random.default_rng(2)
+        xs = rng.lognormal(mean=9.0, sigma=1.5, size=50_000).astype(np.float32)
+        sk = jax.jit(Q.update)(Q.init(alpha=0.01), jnp.asarray(xs))
+        for q in (0.5, 0.95, 0.99):
+            got = float(Q.quantile(sk, q))
+            want = float(np.quantile(xs, q))
+            assert abs(got - want) / want < 0.021  # 2*alpha margin
+
+    def test_grouped_update(self):
+        sk = Q.init(shape=(3,))
+        values = jnp.asarray([100.0, 200.0, 100.0, 1e6])
+        groups = jnp.asarray([0, 0, 1, 2])
+        sk = jax.jit(Q.update_grouped)(sk, groups, values)
+        counts = np.asarray(Q.count(sk))
+        np.testing.assert_array_equal(counts, [2, 1, 1])
+        assert float(Q.quantile(sk, 0.5)[2]) == pytest.approx(1e6, rel=0.02)
+
+    def test_merge(self):
+        a = Q.update(Q.init(), jnp.asarray([10.0] * 100))
+        b = Q.update(Q.init(), jnp.asarray([1000.0] * 100))
+        m = Q.merge(a, b)
+        assert float(Q.count(m)) == 200
+        assert float(Q.quantile(m, 0.99)) == pytest.approx(1000.0, rel=0.02)
+
+    def test_empty_is_nan(self):
+        assert np.isnan(float(Q.quantile(Q.init(), 0.5)))
+
+    def test_valid_mask(self):
+        sk = Q.update(
+            Q.init(), jnp.asarray([10.0, 1e9]), valid=jnp.asarray([True, False])
+        )
+        assert float(Q.count(sk)) == 1
+
+
+class TestTopK:
+    def test_exact_topk(self):
+        state = topk.init(100)
+        ids = jnp.asarray([5, 5, 5, 9, 9, 3])
+        state = jax.jit(topk.update)(state, ids)
+        vals, got = topk.top_k(state, 2)
+        np.testing.assert_array_equal(np.asarray(got), [5, 9])
+        np.testing.assert_array_equal(np.asarray(vals), [3, 2])
+
+    def test_out_of_range_and_invalid_dropped(self):
+        state = topk.init(4)
+        state = topk.update(
+            state,
+            jnp.asarray([0, 7, -1, 2, 2]),
+            valid=jnp.asarray([True, True, True, True, False]),
+        )
+        np.testing.assert_array_equal(np.asarray(state.counts), [1, 0, 1, 0])
+
+    def test_weighted_merge(self):
+        a = topk.update(topk.init(8), jnp.asarray([1]), weights=jnp.asarray([10.0]))
+        b = topk.update(topk.init(8), jnp.asarray([1, 2]), weights=jnp.asarray([5.0, 99.0]))
+        m = topk.merge(a, b)
+        vals, ids = topk.top_k(m, 2)
+        np.testing.assert_array_equal(np.asarray(ids), [2, 1])
+        np.testing.assert_array_equal(np.asarray(vals), [99.0, 15.0])
+
+    def test_topk_from_cms(self):
+        keys = np.array([11, 22, 33], np.int64)
+        hi, lo = split64(keys)
+        sk = cms.update(cms.init(), hi, lo, weights=jnp.asarray([5, 50, 2]))
+        vals, pos = topk.topk_from_cms(sk, jnp.asarray(hi), jnp.asarray(lo), 2)
+        assert int(pos[0]) == 1 and int(vals[0]) == 50

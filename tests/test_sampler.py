@@ -1,0 +1,138 @@
+"""Sampler tests: threshold semantics + each adaptive stage as a pure fn
+(reference pattern: AdaptiveSamplerTest tests stages without ZK)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zipkin_tpu.sampler import (
+    AdaptiveConfig,
+    AdaptiveSampleRateController,
+    Sampler,
+    calculate_sample_rate,
+    cooldown_check,
+    discounted_average,
+    outlier_check,
+    rate_to_threshold,
+    request_rate_check,
+    sample_mask,
+    sufficient_data_check,
+    valid_data_check,
+)
+from zipkin_tpu.sampler.core import LONG_MAX, LONG_MIN
+
+
+class TestSamplerCore:
+    def test_rate_one_keeps_everything(self):
+        tids = jnp.asarray([0, 1, -1, LONG_MAX, LONG_MIN], jnp.int64)
+        mask = sample_mask(tids, jnp.zeros(5, bool), rate_to_threshold(1.0))
+        assert bool(mask.all())
+
+    def test_rate_zero_drops_everything_except_debug(self):
+        tids = jnp.asarray([5, LONG_MAX, -7], jnp.int64)
+        debug = jnp.asarray([False, False, True])
+        mask = sample_mask(tids, debug, rate_to_threshold(0.0))
+        np.testing.assert_array_equal(np.asarray(mask), [False, False, True])
+
+    def test_statistical_rate(self):
+        rng = np.random.default_rng(3)
+        tids = rng.integers(LONG_MIN, LONG_MAX, size=200_000, dtype=np.int64)
+        mask = sample_mask(jnp.asarray(tids), jnp.zeros(len(tids), bool),
+                           rate_to_threshold(0.2))
+        frac = float(np.asarray(mask).mean())
+        assert abs(frac - 0.2) < 0.01
+
+    def test_consistent_with_host_sampler(self):
+        rng = np.random.default_rng(4)
+        tids = rng.integers(LONG_MIN, LONG_MAX, size=500, dtype=np.int64)
+        s = Sampler(0.35)
+        host = np.array([s(int(t)) for t in tids])
+        dev = np.asarray(
+            sample_mask(jnp.asarray(tids), jnp.zeros(500, bool), s.threshold)
+        )
+        np.testing.assert_array_equal(host, dev)
+
+    def test_min_value_maps_to_max(self):
+        # Long.MinValue is treated as MaxValue → kept at any rate > 0.
+        mask = sample_mask(jnp.asarray([LONG_MIN], jnp.int64),
+                           jnp.zeros(1, bool), rate_to_threshold(0.01))
+        assert bool(mask[0])
+
+
+class TestStages:
+    def test_request_rate_check(self):
+        assert request_rate_check([1.0], 0) is None
+        assert request_rate_check([1.0], 10) == [1.0]
+        assert request_rate_check(None, 10) is None
+
+    def test_sufficient_data_check(self):
+        assert sufficient_data_check([1, 2], 3) is None
+        assert sufficient_data_check([1, 2, 3], 3) == [1, 2, 3]
+
+    def test_valid_data_check(self):
+        assert valid_data_check([1, 0, 2]) == [1, 0, 2]
+        assert valid_data_check([1, -1]) is None
+
+    def test_outlier_check_requires_persistent_deviation(self):
+        target = 100.0
+        # all within 15% → no move
+        assert outlier_check([100, 105, 110], target, 3) is None
+        # persistently above
+        assert outlier_check([200, 210, 190], target, 3) is not None
+        # one in-range sample in the tail cancels it
+        assert outlier_check([200, 100, 190], target, 3) is None
+
+    def test_discounted_average_weights_recent(self):
+        # newest sample (last) dominates
+        avg_rising = discounted_average([0, 0, 100])
+        avg_falling = discounted_average([100, 0, 0])
+        assert avg_rising > avg_falling
+        assert discounted_average([50, 50, 50]) == pytest.approx(50)
+
+    def test_calculate_sample_rate_linear_controller(self):
+        # storing 200/min, target 100/min, rate 1.0 → halve
+        got = calculate_sample_rate([200.0] * 5, 1.0, 100.0)
+        assert got == pytest.approx(0.5, rel=0.01)
+
+    def test_calculate_sample_rate_change_threshold(self):
+        # 3% change is under the 5% threshold → no update
+        assert calculate_sample_rate([103.0] * 5, 1.0, 100.0) is None
+
+    def test_calculate_sample_rate_clamped(self):
+        got = calculate_sample_rate([10.0] * 5, 0.5, 100.0)
+        assert got == 1.0  # would be 5.0, clamped
+
+    def test_cooldown(self):
+        assert cooldown_check(0.5, 10.0, None, 30.0) == 0.5
+        assert cooldown_check(0.5, 10.0, 0.0, 30.0) is None
+        assert cooldown_check(0.5, 40.0, 0.0, 30.0) == 0.5
+
+
+class TestController:
+    def make(self, target=100.0):
+        cfg = AdaptiveConfig(
+            target_store_rate=target, update_freq_s=30.0,
+            window_s=300.0, sufficient_window_s=90.0, outlier_window_s=60.0,
+        )
+        return AdaptiveSampleRateController(cfg)
+
+    def test_converges_toward_target(self):
+        ctl = self.make(target=100.0)
+        # Closed loop: the raw flow is 400 spans/min; the store sees
+        # flow * rate. The controller should settle near rate 0.25.
+        now = 0.0
+        for _ in range(20):
+            ctl.observe(400.0 * ctl.rate, now)
+            now += 30
+        assert ctl.rate == pytest.approx(0.25, rel=0.15)
+
+    def test_no_move_when_on_target(self):
+        ctl = self.make(target=100.0)
+        now = 0.0
+        moved = [ctl.observe(100.0, now + 30 * i) for i in range(10)]
+        assert all(m is None for m in moved)
+        assert ctl.rate == 1.0
+
+    def test_disabled_when_target_zero(self):
+        ctl = self.make(target=0.0)
+        assert all(ctl.observe(500.0, 30.0 * i) is None for i in range(10))

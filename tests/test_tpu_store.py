@@ -1,0 +1,195 @@
+"""TpuSpanStore: conformance suite + device-store analytics.
+
+The same behavioral suite the in-memory store passes runs against the
+device store (reference pattern: SpanStoreValidator reused across
+backends, SpanStoreValidator.scala:27).
+"""
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.models.span import Annotation, BinaryAnnotation, Endpoint, Span
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.testing.conformance import (
+    conformance_test_names,
+    run_conformance_test,
+)
+
+SMALL = StoreConfig(
+    capacity=1 << 10,
+    ann_capacity=1 << 12,
+    bann_capacity=1 << 11,
+    max_services=32,
+    max_span_names=128,
+    max_annotation_values=256,
+    max_binary_keys=64,
+    cms_width=1 << 10,
+    hll_p=8,
+    quantile_buckets=512,
+)
+
+
+def small_store() -> TpuSpanStore:
+    return TpuSpanStore(SMALL)
+
+
+@pytest.mark.parametrize("name", conformance_test_names())
+def test_tpu_store_conformance(name):
+    run_conformance_test(name, small_store)
+
+
+def _rpc(trace_id, span_id, parent, client, server, t0, t1, name="call"):
+    cl = Endpoint(1, 1, client)
+    sv = Endpoint(2, 2, server)
+    mid = (t0 + t1) // 2
+    return Span(
+        trace_id, name, span_id, parent,
+        (
+            Annotation(t0, "cs", cl),
+            Annotation(t0 + 1, "sr", sv),
+            Annotation(mid, "custom-work", sv),
+            Annotation(t1 - 1, "ss", sv),
+            Annotation(t1, "cr", cl),
+        ),
+        (BinaryAnnotation("http.uri", b"/x", host=sv),),
+    )
+
+
+class TestAnalytics:
+    def make_loaded(self):
+        store = small_store()
+        spans = []
+        for t in range(20):
+            tid = 1000 + t
+            spans.append(_rpc(tid, 1, None, "web", "api", 100, 1100))
+            spans.append(_rpc(tid, 2, 1, "api", "db", 200, 700))
+        store.apply(spans)
+        return store
+
+    def test_dependency_links_from_streaming_join(self):
+        # Shared-span model: the root span (client web / server api) has
+        # no parent, so the only parent→child join is span1→span2, i.e.
+        # (api → db) — matching ZipkinAggregateJob's parent×child join.
+        store = self.make_loaded()
+        deps = store.get_dependencies()
+        got = {(l.parent, l.child): l for l in deps.links}
+        assert set(got) == {("api", "db")}
+        assert got[("api", "db")].duration_moments.count == 20
+        assert got[("api", "db")].duration_moments.mean == pytest.approx(500.0)
+
+    def test_service_quantiles(self):
+        store = self.make_loaded()
+        p50 = store.service_duration_quantiles("db", [0.5])
+        assert p50 is not None
+        assert p50[0] == pytest.approx(500.0, rel=0.03)
+
+    def test_unique_trace_estimate(self):
+        store = self.make_loaded()
+        est = store.estimated_unique_traces()
+        assert abs(est - 20) <= 3
+
+    def test_top_annotations(self):
+        store = self.make_loaded()
+        top = store.top_annotations("db", k=3)
+        assert top and top[0][0] == "custom-work"
+        assert top[0][1] == 20
+
+    def test_counters(self):
+        store = self.make_loaded()
+        c = store.counters()
+        assert c["spans_seen"] == 40
+        assert c["batches"] >= 1
+
+    def test_multi_batch_accumulation(self):
+        store = small_store()
+        store.apply([_rpc(1, 1, None, "w", "a", 0, 1000),
+                     _rpc(1, 2, 1, "a", "b", 100, 200)])
+        store.apply([_rpc(2, 1, None, "w", "a", 0, 1000),
+                     _rpc(2, 2, 1, "a", "b", 100, 400)])
+        deps = store.get_dependencies()
+        link = {(l.parent, l.child): l for l in deps.links}[("a", "b")]
+        assert link.duration_moments.count == 2
+
+
+class TestReviewRegressions:
+    def test_str_binary_value_found_by_bytes_query(self):
+        # Stored as str, queried as bytes (the SPI's wire form): must hit.
+        store = small_store()
+        ep = Endpoint(1, 1, "svc")
+        store.apply([
+            Span(7, "op", 1, None, (Annotation(10, "x", ep),),
+                 (BinaryAnnotation("http.method", "GET", host=ep),))
+        ])
+        ids = store.get_trace_ids_by_annotation(
+            "svc", "http.method", b"GET", 100, 10
+        )
+        assert [i.trace_id for i in ids] == [7]
+
+    def test_unsigned_trace_ids_roundtrip_queries(self):
+        big = 2**63 + 5  # unsigned wire id; stored signed
+        store = small_store()
+        ep = Endpoint(1, 1, "svc")
+        store.apply([Span(big, "op", 1, None, (Annotation(10, "x", ep),), ())])
+        assert store.traces_exist([big]) == {big}
+        found = store.get_spans_by_trace_ids([big])
+        assert len(found) == 1 and len(found[0]) == 1
+        durs = store.get_traces_duration([big])
+        assert durs and durs[0].trace_id == big
+
+    def test_oversized_batch_rejected_but_apply_chunks(self):
+        cfg = StoreConfig(
+            capacity=32, ann_capacity=128, bann_capacity=64,
+            max_services=8, max_span_names=16, max_annotation_values=32,
+            max_binary_keys=8, cms_width=256, hll_p=4, quantile_buckets=64,
+        )
+        store = TpuSpanStore(cfg)
+        from zipkin_tpu.columnar.encode import SpanCodec
+
+        spans = [
+            Span(t, "op", 1, None,
+                 (Annotation(10, "x", Endpoint(1, 1, "svc")),), ())
+            for t in range(40)
+        ]
+        batch = store.codec.encode(spans)
+        with pytest.raises(ValueError):
+            store.write_batch(batch, np.ones(40, bool))
+        # apply() chunks internally and succeeds (last 32 survive).
+        store2 = TpuSpanStore(cfg)
+        store2.apply(spans)
+        assert store2.counters()["spans_seen"] == 40
+
+
+class TestRingEviction:
+    def test_overwrite_drops_old_traces(self):
+        cfg = StoreConfig(
+            capacity=8, ann_capacity=64, bann_capacity=32,
+            max_services=8, max_span_names=16, max_annotation_values=32,
+            max_binary_keys=8, cms_width=256, hll_p=4, quantile_buckets=64,
+        )
+        store = TpuSpanStore(cfg)
+        for t in range(16):
+            store.apply([_rpc(t, 1, None, "w", "s", t * 10, t * 10 + 5)])
+        # Only the last 8 traces remain addressable.
+        assert store.traces_exist(list(range(16))) == set(range(8, 16))
+        # Evicted span rows must not satisfy index queries.
+        ids = store.get_trace_ids_by_name("w", None, 10**9, 100)
+        assert {i.trace_id for i in ids} == set(range(8, 16))
+        # Annotations of evicted spans are not returned.
+        found = store.get_spans_by_trace_ids([3])
+        assert found == []
+
+    def test_sketches_survive_eviction(self):
+        cfg = StoreConfig(
+            capacity=8, ann_capacity=64, bann_capacity=32,
+            max_services=8, max_span_names=16, max_annotation_values=32,
+            max_binary_keys=8, cms_width=256, hll_p=8, quantile_buckets=64,
+        )
+        store = TpuSpanStore(cfg)
+        for t in range(32):
+            store.apply([_rpc(t, 1, None, "w", "s", 0, 1000),
+                         _rpc(t, 2, 1, "s", "d", 100, 200)])
+        deps = store.get_dependencies()
+        link = {(l.parent, l.child): l for l in deps.links}[("s", "d")]
+        assert link.duration_moments.count == 32  # aggregates never evict
+        assert store.counters()["spans_seen"] == 64

@@ -1,0 +1,118 @@
+"""Tracegen: object generator parity shape + columnar generator integrity
++ the end-to-end write/query-back smoke (tracegen/Main.scala:48-117)."""
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.columnar.dictionary import DictionarySet
+from zipkin_tpu.models.constants import CORE_ANNOTATIONS
+from zipkin_tpu.models.trace import Trace
+from zipkin_tpu.store.memory import InMemorySpanStore
+from zipkin_tpu.tracegen import ColumnarTraceGen, generate_traces
+
+
+class TestObjectGenerator:
+    def test_shape(self):
+        traces = generate_traces(n_traces=5, max_depth=7)
+        assert len(traces) == 5
+        for spans in traces:
+            assert len(spans) >= 1
+            tids = {s.trace_id for s in spans}
+            assert len(tids) == 1
+            root = [s for s in spans if s.parent_id is None]
+            assert len(root) == 1
+            for s in spans:
+                values = [a.value for a in s.annotations]
+                assert set(values) & CORE_ANNOTATIONS == {"cs", "sr", "ss", "cr"}
+                assert s.binary_annotations
+                assert s.duration is not None and s.duration > 0
+
+    def test_tree_depth_bounded(self):
+        traces = generate_traces(n_traces=10, max_depth=3)
+        for spans in traces:
+            t = Trace(spans)
+            tree = t.get_span_tree(t.get_root_span())
+            assert max(tree.depths(1).values()) <= 3
+
+    def test_deterministic_by_seed(self):
+        a = generate_traces(3, rng=np.random.default_rng(7))
+        b = generate_traces(3, rng=np.random.default_rng(7))
+        assert a == b
+
+    def test_end_to_end_smoke_queryback(self):
+        """Write through a store and read back via every SPI query."""
+        store = InMemorySpanStore()
+        traces = generate_traces(n_traces=5)
+        for spans in traces:
+            store.apply(spans)
+        services = store.get_all_service_names()
+        assert services
+        svc = sorted(services)[0]
+        assert store.get_span_names(svc)
+        end_ts = 10**18
+        ids = store.get_trace_ids_by_name(svc, None, end_ts, 10)
+        assert ids
+        got = store.get_spans_by_trace_ids([i.trace_id for i in ids])
+        assert got
+        durations = store.get_traces_duration([i.trace_id for i in ids])
+        assert all(d.duration >= 0 for d in durations)
+
+
+class TestColumnarGenerator:
+    def make(self, spt=7):
+        return ColumnarTraceGen(DictionarySet(), n_services=16,
+                                n_span_names=32, spans_per_trace=spt)
+
+    def test_batch_shape_and_tree(self):
+        gen = self.make()
+        batch, name_lc, indexable = gen.next_batch(10)
+        assert batch.n_spans == 70
+        assert batch.n_annotations == 140
+        assert batch.n_binary == 70
+        # Heap tree: every non-root's parent is in the same trace.
+        for t in range(10):
+            rows = slice(t * 7, (t + 1) * 7)
+            tid = set(batch.trace_id[rows].tolist())
+            assert len(tid) == 1
+            sids = set(batch.span_id[rows].tolist())
+            parents = batch.parent_id[rows][1:]  # non-roots
+            assert set(parents.tolist()) <= sids
+
+    def test_unique_trace_ids_across_batches(self):
+        gen = self.make()
+        b1, _, _ = gen.next_batch(50)
+        b2, _, _ = gen.next_batch(50)
+        ids = np.concatenate([b1.trace_id, b2.trace_id])
+        assert len(np.unique(ids)) == 100
+
+    def test_timestamps_consistent(self):
+        gen = self.make()
+        batch, _, _ = gen.next_batch(20)
+        assert (batch.ts_first <= batch.ts_last).all()
+        assert (batch.duration == batch.ts_last - batch.ts_first).all()
+        assert (batch.ts_cs == batch.ts_first).all()
+        assert (batch.ts_cr == batch.ts_last).all()
+
+    def test_feeds_tpu_store(self):
+        from zipkin_tpu.columnar.encode import SpanCodec
+        from zipkin_tpu.store.device import StoreConfig
+        from zipkin_tpu.store.tpu import TpuSpanStore
+
+        cfg = StoreConfig(
+            capacity=1 << 10, ann_capacity=1 << 11, bann_capacity=1 << 10,
+            max_services=32, max_span_names=64, max_annotation_values=64,
+            max_binary_keys=16, cms_width=1 << 10, hll_p=8,
+            quantile_buckets=256,
+        )
+        store = TpuSpanStore(cfg)
+        gen = ColumnarTraceGen(store.dicts, n_services=8, n_span_names=16)
+        batch, name_lc, indexable = gen.next_batch(32)
+        store.write_batch(batch, indexable)
+        assert store.counters()["spans_seen"] == 32 * 7
+        # Dep links exist (heap tree has parent-child pairs).
+        deps = store.get_dependencies()
+        total = sum(l.duration_moments.count for l in deps.links)
+        assert total == 32 * 6  # every non-root joins its parent
+        # Service catalog populated via annotation rows.
+        assert store.get_all_service_names() <= {f"svc-{i:04d}" for i in range(8)}
+        assert store.get_all_service_names()

@@ -18,6 +18,18 @@ The compute path is JAX (jit/shard_map/pallas); strings live in a host
 dictionary encoder, the device sees only fixed-width integers/floats.
 """
 
+import os as _os
+
+if _os.environ.get("ZIPKIN_TPU_X64", "1") != "0":
+    # 64-bit trace/span ids and µs timestamps are core to the domain, so the
+    # framework runs JAX in x64 mode. The performance-critical paths
+    # (sketches, hashing) still use explicit 32-bit dtypes — see
+    # ops/hashing.py — so only the id/timestamp columns pay the TPU's
+    # int64 emulation cost, and only on the query path.
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
+
 __version__ = "0.1.0"
 
 from zipkin_tpu.models.span import (  # noqa: F401

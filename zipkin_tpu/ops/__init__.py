@@ -1,0 +1,15 @@
+"""Device-side streaming operators (the framework's "kernels").
+
+Everything here is pure-functional, jit/vmap/shard_map friendly, and uses
+only 32-bit integer arithmetic (TPU-native: 64-bit ids travel as
+(hi, lo) uint32 word pairs, see ops/hashing.py). Every sketch has a
+``merge`` that is associative+commutative so cross-shard combination is a
+plain tree reduction / ``psum``-style collective.
+
+Role parity with the reference (SURVEY.md §2.8 native-role table):
+algebird ``Moments`` → ops.moments; dependency-link & heavy-hitter
+counting → ops.cms/ops.topk; cardinality → ops.hll; latency
+percentiles → ops.quantile.
+"""
+
+from zipkin_tpu.ops import cms, hashing, hll, moments, quantile, topk  # noqa: F401

@@ -1,0 +1,82 @@
+"""32-bit hashing primitives for 64-bit keys on TPU.
+
+TPUs emulate 64-bit integer ops, so device code works on (hi, lo) uint32
+word pairs. Host code splits numpy int64 columns once at upload time.
+
+The mixer is murmur3's fmix32 finalizer — full avalanche on 32 bits —
+composed over the two words with distinct odd multipliers per seed, which
+gives the independent hash families the sketches need (count-min rows,
+HLL index/rank).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+GOLDEN32 = np.uint32(0x9E3779B9)
+
+
+def split64(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host: int64 column → (hi, lo) uint32 columns."""
+    u = x.astype(np.int64).view(np.uint64)
+    return (u >> np.uint64(32)).astype(np.uint32), (
+        u & np.uint64(0xFFFFFFFF)
+    ).astype(np.uint32)
+
+
+def join64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Host: (hi, lo) uint32 columns → int64 column."""
+    u = (np.asarray(hi, np.uint64) << np.uint64(32)) | np.asarray(lo, np.uint64)
+    return u.view(np.int64)
+
+
+def dev_split64(x):
+    """Device: int64 array → (hi, lo) uint32 arrays (requires x64 mode)."""
+    u = jnp.asarray(x).astype(jnp.uint64)
+    return (u >> 32).astype(jnp.uint32), (u & jnp.uint64(0xFFFFFFFF)).astype(
+        jnp.uint32
+    )
+
+
+def fmix32(h):
+    """murmur3 finalizer: full-avalanche bijective mixer on uint32."""
+    h = jnp.asarray(h, jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash2_32(hi, lo, seed):
+    """Hash a (hi, lo) 64-bit key to 32 bits under an integer ``seed``.
+
+    Distinct seeds give (empirically) independent hash functions; used as
+    the hash family for count-min rows and the HLL index/rank pair.
+    """
+    s = jnp.uint32(seed) * GOLDEN32 + jnp.uint32(1)
+    h = fmix32(jnp.asarray(lo, jnp.uint32) ^ s)
+    h = fmix32(h ^ jnp.asarray(hi, jnp.uint32) ^ (s * jnp.uint32(0x85EBCA6B)))
+    return h
+
+
+def clz32(x):
+    """Count leading zeros of uint32 (vectorized, integer-only)."""
+    x = jnp.asarray(x, jnp.uint32)
+    n = jnp.zeros(x.shape, jnp.int32)
+    zero = x == 0
+    for bits, mask in (
+        (16, jnp.uint32(0xFFFF0000)),
+        (8, jnp.uint32(0xFF000000)),
+        (4, jnp.uint32(0xF0000000)),
+        (2, jnp.uint32(0xC0000000)),
+        (1, jnp.uint32(0x80000000)),
+    ):
+        hi_clear = (x & mask) == 0
+        n = jnp.where(hi_clear, n + bits, n)
+        x = jnp.where(hi_clear, x << bits, x)
+    return jnp.where(zero, jnp.int32(32), n)

@@ -1,0 +1,71 @@
+"""HyperLogLog cardinality sketch over 64-bit keys.
+
+Distinct-count estimation (unique trace ids, unique endpoints) with
+``1.04/sqrt(m)`` relative standard error (~0.8% at the default p=14).
+
+TPU-native twist: instead of slicing one 64-bit hash we draw two
+independent 32-bit hashes — one for the register index, one for the rank
+(leading-zero count) — so all arithmetic stays uint32. Rank ≤ 33 caps the
+estimator around 2^33 distinct keys per register draw, beyond the 1B-span
+target. Update is a scatter-max; merge is elementwise max (idempotent,
+commutative — safe to combine shards via ``lax.max`` tree reduction).
+
+Small-range bias is corrected with linear counting below 2.5m, as in
+Flajolet et al. 2007.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from zipkin_tpu.ops.hashing import clz32, hash2_32
+
+DEFAULT_P = 14
+
+
+class HyperLogLog(NamedTuple):
+    registers: jnp.ndarray  # [2^p] int32 max-rank per register
+
+    @property
+    def m(self) -> int:
+        return self.registers.shape[0]
+
+
+def init(p: int = DEFAULT_P) -> HyperLogLog:
+    return HyperLogLog(jnp.zeros(1 << p, jnp.int32))
+
+
+def _alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+def update(sketch: HyperLogLog, key_hi, key_lo, valid=None) -> HyperLogLog:
+    key_hi = jnp.asarray(key_hi, jnp.uint32)
+    key_lo = jnp.asarray(key_lo, jnp.uint32)
+    idx = (hash2_32(key_hi, key_lo, 101) & jnp.uint32(sketch.m - 1)).astype(jnp.int32)
+    rank = clz32(hash2_32(key_hi, key_lo, 202)) + 1  # 1..33
+    if valid is not None:
+        rank = jnp.where(jnp.asarray(valid, bool), rank, 0)
+    return HyperLogLog(sketch.registers.at[idx].max(rank))
+
+
+def merge(a: HyperLogLog, b: HyperLogLog) -> HyperLogLog:
+    return HyperLogLog(jnp.maximum(a.registers, b.registers))
+
+
+def estimate(sketch: HyperLogLog):
+    """Estimated distinct-key count (float32 scalar on device)."""
+    m = sketch.m
+    regs = sketch.registers.astype(jnp.float32)
+    raw = _alpha(m) * m * m / jnp.sum(jnp.exp2(-regs))
+    zeros = jnp.sum(sketch.registers == 0).astype(jnp.float32)
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    return jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)

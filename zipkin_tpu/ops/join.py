@@ -1,0 +1,77 @@
+"""Vectorized equi-join primitives (sort-merge, no pointers).
+
+The device-side replacement for the reference's shuffle joins — the
+Scalding ``parentSpans join childSpans on (parentId, traceId)``
+(ZipkinAggregateJob.scala:26-33) and the SQL self-joins
+(AnormAggregator.scala:32-90) — re-expressed as one lexsort over the
+union of build and probe rows plus a forward-fill, which XLA lowers to
+its O(n log n) sort: no hash tables, no dynamic shapes.
+
+``lookup``: for each probe key, find the payload of the (single) build
+row with an equal composite key. Keys are tuples of integer columns
+(e.g. (trace_id, span_id) as int64 columns in x64 mode).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _forward_fill_last_true_index(flag):
+    """For each i: the largest j <= i with flag[j], else -1."""
+    idx = jnp.where(flag, jnp.arange(flag.shape[0]), -1)
+    return jax.lax.associative_scan(jnp.maximum, idx)
+
+
+def lookup(
+    build_keys: Sequence[jnp.ndarray],
+    build_valid: jnp.ndarray,
+    build_values: jnp.ndarray,
+    probe_keys: Sequence[jnp.ndarray],
+    probe_valid: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (found, values) for each probe row.
+
+    found[i] is True iff some valid build row's composite key equals probe
+    i's key; values[i] is that row's payload (0 where not found). If
+    multiple build rows share a key, the one latest in sort order wins.
+    """
+    n_b = build_keys[0].shape[0]
+    n_q = probe_keys[0].shape[0]
+    n = n_b + n_q
+    keys = [
+        jnp.concatenate([jnp.asarray(b), jnp.asarray(q)])
+        for b, q in zip(build_keys, probe_keys)
+    ]
+    is_build = jnp.concatenate(
+        [jnp.asarray(build_valid, bool), jnp.zeros(n_q, bool)]
+    )
+    # Tie-break so build rows sort before the probes that match them.
+    tag = jnp.concatenate([jnp.zeros(n_b, jnp.int32), jnp.ones(n_q, jnp.int32)])
+    payload = jnp.concatenate(
+        [jnp.asarray(build_values), jnp.zeros(n_q, jnp.asarray(build_values).dtype)]
+    )
+    # lexsort: last key is primary → (tag, key[-1], ..., key[0]).
+    order = jnp.lexsort(tuple([tag] + list(reversed(keys))))
+    s_keys = [k[order] for k in keys]
+    s_build = is_build[order]
+    s_payload = payload[order]
+    src = _forward_fill_last_true_index(s_build)
+    src_c = jnp.clip(src, 0, n - 1)
+    same_key = src >= 0
+    for k in s_keys:
+        same_key = same_key & (k[src_c] == k)
+    hit = same_key & ~s_build
+    val = jnp.where(hit, s_payload[src_c], 0)
+    # Scatter back to original probe order (build rows routed to the OOB
+    # slot n_q and dropped — negative indices would wrap, not drop).
+    probe_pos = jnp.concatenate(
+        [jnp.full(n_b, n_q, jnp.int32), jnp.arange(n_q, dtype=jnp.int32)]
+    )[order]
+    found = jnp.zeros(n_q, bool).at[probe_pos].set(hit, mode="drop")
+    values = jnp.zeros(n_q, payload.dtype).at[probe_pos].set(val, mode="drop")
+    found = found & jnp.asarray(probe_valid, bool)
+    return found, values

@@ -1,0 +1,114 @@
+"""Vectorized streaming central moments (algebird ``Moments`` on device).
+
+State layout: a trailing-dim-5 array ``[..., (n, mean, m2, m3, m4)]`` —
+same central form as models/dependencies.Moments and the thrift wire
+m0..m4 (zipkinDependencies.thrift). ``combine`` is the Chan/Pébay
+pairwise formula, identical to ``Moments.__add__`` on the host, so
+device-aggregated moments and host-aggregated moments agree bit-for-bit
+up to dtype.
+
+``segment_moments`` computes exact per-segment moments in two
+``segment_sum`` passes (mean first, then centered powers) — the
+device-side replacement for the reference's per-link
+``Moments(child.duration)`` monoid-sum (ZipkinAggregateJob.scala:36-46).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+N_FIELDS = 5  # n, mean, m2, m3, m4
+
+
+def zero(shape=(), dtype=jnp.float32):
+    return jnp.zeros(tuple(shape) + (N_FIELDS,), dtype)
+
+
+def of(x):
+    """Moments of single observations: x[...] → [..., 5]."""
+    x = jnp.asarray(x)
+    z = jnp.zeros_like(x)
+    return jnp.stack([jnp.ones_like(x), x, z, z, z], axis=-1)
+
+
+def combine(a, b):
+    """Pairwise combine, elementwise over leading dims ([...,5],[...,5])."""
+    na, ma, m2a, m3a, m4a = [a[..., i] for i in range(N_FIELDS)]
+    nb, mb, m2b, m3b, m4b = [b[..., i] for i in range(N_FIELDS)]
+    n = na + nb
+    safe_n = jnp.where(n > 0, n, 1)
+    delta = mb - ma
+    d_n = delta / safe_n
+    mean = ma + nb * d_n
+    m2 = m2a + m2b + delta * d_n * na * nb
+    m3 = (
+        m3a
+        + m3b
+        + delta * d_n * d_n * na * nb * (na - nb)
+        + 3.0 * d_n * (na * m2b - nb * m2a)
+    )
+    m4 = (
+        m4a
+        + m4b
+        + delta * d_n**3 * na * nb * (na * na - na * nb + nb * nb)
+        + 6.0 * d_n * d_n * (na * na * m2b + nb * nb * m2a)
+        + 4.0 * d_n * (na * m3b - nb * m3a)
+    )
+    out = jnp.stack([n, mean, m2, m3, m4], axis=-1)
+    # Monoid identities: empty side contributes nothing.
+    out = jnp.where((na == 0)[..., None], b, out)
+    out = jnp.where((nb == 0)[..., None], a, out)
+    return out
+
+
+def segment_moments(values, segment_ids, num_segments, valid=None, dtype=jnp.float32):
+    """Exact per-segment moments: values[i] → segment segment_ids[i].
+
+    ``valid`` masks out padding rows. Returns [num_segments, 5].
+    Two-pass: segment mean, then segment sums of centered powers — exact
+    (not an approximation of sequential updates) and scatter-add only.
+    """
+    x = jnp.asarray(values, dtype)
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    w = jnp.ones_like(x) if valid is None else jnp.asarray(valid, dtype)
+    # Route masked rows to a scratch segment so they can't pollute real ones.
+    seg = jnp.where(w > 0, seg, num_segments)
+    n = jax.ops.segment_sum(w, seg, num_segments + 1)
+    sx = jax.ops.segment_sum(w * x, seg, num_segments + 1)
+    mean = sx / jnp.where(n > 0, n, 1)
+    c = (x - mean[seg]) * w
+    m2 = jax.ops.segment_sum(c * c, seg, num_segments + 1)
+    m3 = jax.ops.segment_sum(c * c * c, seg, num_segments + 1)
+    m4 = jax.ops.segment_sum(c * c * c * c, seg, num_segments + 1)
+    return jnp.stack([n, mean, m2, m3, m4], axis=-1)[:num_segments]
+
+
+def reduce_moments(m, axis: int = 0):
+    """Tree-reduce a stack of moments [..., k, 5] along ``axis`` via combine.
+
+    log2(k) combine steps — the in-graph analogue of algebird's monoid
+    ``sum`` over a collection of Moments.
+    """
+    m = jnp.moveaxis(m, axis, 0)
+    k = m.shape[0]
+    while k > 1:
+        if k % 2:
+            m = jnp.concatenate([m, zero(m.shape[1:-1], m.dtype)[None]], axis=0)
+            k += 1
+        m = combine(m[0::2], m[1::2])
+        k = m.shape[0]
+    return m[0]
+
+
+def variance(m):
+    n = m[..., 0]
+    return m[..., 2] / jnp.where(n > 0, n, 1)
+
+
+def mean(m):
+    return m[..., 1]
+
+
+def count(m):
+    return m[..., 0]

@@ -1,0 +1,135 @@
+"""Mergeable log-histogram quantile sketch (DDSketch-style).
+
+Latency percentiles (p50/p95/p99 per service) with a *relative* accuracy
+guarantee: with ``alpha`` = 0.01, any returned quantile is within ±1% of
+a true quantile value. Chosen over t-digest because its update is a pure
+scatter-add into a fixed-size array and its merge is ``+`` — exactly the
+shape the TPU wants (t-digest's centroid list is sequential and
+data-dependent; cf. the moment-sketch line of work in PAPERS.md, which we
+also expose via ops.moments).
+
+Bucket ``i`` covers values in ``(min_value * gamma^(i-1), min_value *
+gamma^i]`` with ``gamma = (1+alpha)/(1-alpha)``; values ≤ min_value land
+in bucket 0. Durations are microseconds, so ``min_value=1.0`` and 2048
+buckets cover up to ~10^17 µs at alpha=0.01.
+
+State supports leading batch dims: ``[..., n_buckets]`` — a per-service
+sketch bank is just ``[n_services, n_buckets]`` updated with one 2-D
+scatter-add.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_ALPHA = 0.01
+DEFAULT_BUCKETS = 2048
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class LogHistogram:
+    counts: jnp.ndarray  # [..., n_buckets]
+    gamma: float  # static (pytree aux): never traced
+    min_value: float  # static (pytree aux)
+
+    @property
+    def n_buckets(self) -> int:
+        return self.counts.shape[-1]
+
+    def _replace(self, **kw) -> "LogHistogram":
+        return replace(self, **kw)
+
+    def tree_flatten(self):
+        return (self.counts,), (self.gamma, self.min_value)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0], aux[1])
+
+
+def init(
+    shape=(),
+    n_buckets: int = DEFAULT_BUCKETS,
+    alpha: float = DEFAULT_ALPHA,
+    min_value: float = 1.0,
+    dtype=jnp.float32,
+) -> LogHistogram:
+    gamma = (1.0 + alpha) / (1.0 - alpha)
+    return LogHistogram(
+        jnp.zeros(tuple(shape) + (n_buckets,), dtype), gamma, min_value
+    )
+
+
+def bucket_index(sketch: LogHistogram, values):
+    """Bucket index per value (int32), clipped into range."""
+    v = jnp.asarray(values, jnp.float32)
+    scaled = jnp.log(jnp.maximum(v, sketch.min_value) / sketch.min_value)
+    idx = jnp.ceil(scaled / math.log(sketch.gamma))
+    return jnp.clip(idx.astype(jnp.int32), 0, sketch.n_buckets - 1)
+
+
+def update(sketch: LogHistogram, values, valid=None) -> LogHistogram:
+    """Flat (no leading dims) update: add each value to its bucket."""
+    idx = bucket_index(sketch, values)
+    w = (
+        jnp.ones(idx.shape, sketch.counts.dtype)
+        if valid is None
+        else jnp.asarray(valid, sketch.counts.dtype)
+    )
+    return sketch._replace(counts=sketch.counts.at[idx].add(w))
+
+
+def update_grouped(sketch: LogHistogram, group_ids, values, valid=None) -> LogHistogram:
+    """Banked update: sketch [G, B]; value i goes to (group_ids[i], bucket)."""
+    idx = bucket_index(sketch, values)
+    g = jnp.asarray(group_ids, jnp.int32)
+    w = (
+        jnp.ones(idx.shape, sketch.counts.dtype)
+        if valid is None
+        else jnp.asarray(valid, sketch.counts.dtype)
+    )
+    n_groups = sketch.counts.shape[0]
+    g = jnp.clip(g, 0, n_groups - 1)
+    flat = g * sketch.n_buckets + idx
+    counts = (
+        sketch.counts.reshape(-1).at[flat].add(w).reshape(sketch.counts.shape)
+    )
+    return sketch._replace(counts=counts)
+
+
+def merge(a: LogHistogram, b: LogHistogram) -> LogHistogram:
+    assert a.gamma == b.gamma and a.min_value == b.min_value
+    return a._replace(counts=a.counts + b.counts)
+
+
+def quantile(sketch: LogHistogram, q):
+    """q-quantile value estimate per leading dim; NaN where count is 0.
+
+    Returns the geometric midpoint of the matched bucket, which meets the
+    ±alpha relative guarantee.
+    """
+    # Explicit float32 throughout: under x64, python-float promotion
+    # would produce float64 ops, which TPUs don't support.
+    counts = sketch.counts.astype(jnp.float32)
+    total = counts.sum(axis=-1, keepdims=True)
+    ranks = jnp.float32(q) * jnp.maximum(total - 1, 0)
+    cum = jnp.cumsum(counts, axis=-1)
+    b = jnp.sum(cum <= ranks, axis=-1)  # first bucket with cum > rank
+    b = jnp.minimum(b, sketch.n_buckets - 1)
+    g = jnp.float32(sketch.gamma)
+    mid = (
+        jnp.float32(sketch.min_value)
+        * jnp.power(g, b.astype(jnp.float32))
+        * (jnp.float32(2.0) / (jnp.float32(1.0) + g))
+    )
+    mid = jnp.where(b == 0, jnp.float32(sketch.min_value), mid)
+    return jnp.where(total[..., 0] > 0, mid, jnp.nan)
+
+
+def count(sketch: LogHistogram):
+    return sketch.counts.sum(axis=-1)

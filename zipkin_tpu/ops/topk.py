@@ -1,0 +1,70 @@
+"""Top-k heavy hitters over dictionary-encoded (bounded) key domains.
+
+Because the host dictionary encoder gives services/span-names/annotation
+keys *dense small ids*, exact counting into a fixed counter array beats
+probabilistic heavy-hitter sketches: update is one scatter-add, merge is
+``+``, and top-k is a single ``lax.top_k`` over the counter array. This
+replaces the reference's ``TopAnnotations`` CF + Scalding count jobs
+(CassieSpanStore.scala, zipkin-aggregate) with an O(capacity) array.
+
+For genuinely unbounded keys, pair ops.cms (estimates) with a host-side
+candidate list; ``topk_from_cms`` supports that path.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from zipkin_tpu.ops import cms
+
+
+class Counters(NamedTuple):
+    counts: jnp.ndarray  # [capacity]
+
+    @property
+    def capacity(self) -> int:
+        return self.counts.shape[0]
+
+
+def init(capacity: int, dtype=jnp.float32) -> Counters:
+    return Counters(jnp.zeros(capacity, dtype))
+
+
+def update(state: Counters, ids, weights=None, valid=None) -> Counters:
+    """Add ``weights`` (default 1) at each id; ids outside capacity and
+    invalid rows are dropped (routed to a scratch slot)."""
+    ids = jnp.asarray(ids, jnp.int32)
+    w = (
+        jnp.ones(ids.shape, state.counts.dtype)
+        if weights is None
+        else jnp.asarray(weights, state.counts.dtype)
+    )
+    ok = (ids >= 0) & (ids < state.capacity)
+    if valid is not None:
+        ok = ok & jnp.asarray(valid, bool)
+    padded = jnp.concatenate([state.counts, jnp.zeros(1, state.counts.dtype)])
+    idx = jnp.where(ok, ids, state.capacity)
+    return Counters(padded.at[idx].add(w)[:-1])
+
+
+def merge(a: Counters, b: Counters) -> Counters:
+    return Counters(a.counts + b.counts)
+
+
+def top_k(state: Counters, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(counts, ids) of the k largest counters (lax.top_k, MXU-free)."""
+    k = min(k, state.capacity)
+    return jax.lax.top_k(state.counts, k)
+
+
+def topk_from_cms(
+    sketch: cms.CountMin, cand_hi, cand_lo, k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Estimated counts + positions of the top-k among candidate keys."""
+    est = cms.query(sketch, cand_hi, cand_lo)
+    k = min(k, int(est.shape[0]))
+    vals, pos = jax.lax.top_k(est, k)
+    return vals, pos

@@ -1,0 +1,18 @@
+"""Multi-chip parallelism: sharded ingest + collective sketch merges.
+
+The reference scales by running stateless collector JVMs behind
+ZooKeeper server-sets and sharding storage rows (SURVEY.md §2.8). The
+TPU design instead shards the *ingest stream* over a device mesh axis
+("shard"): every device owns an independent store state (ring + sketch
+bank) and ingests its slice of the span stream; global answers come from
+XLA collectives over ICI — psum for counters/histograms/count-min, pmax
+for HyperLogLog registers, and an all_gather + tree-combine for the
+Moments banks. No ZooKeeper, no RPC fan-in: the "group snapshot" the
+reference reads from ZK (AdaptiveSampler.scala:204-237) is one psum.
+"""
+
+from zipkin_tpu.parallel.shard import (  # noqa: F401
+    ShardedStore,
+    global_summary,
+    make_sharded_ingest,
+)

@@ -1,0 +1,120 @@
+"""shard_map-ed ingest: N store shards, one collective summary.
+
+Mesh layout: one axis ``shard`` = data-parallel ingest shards (the
+analogue of the reference's horizontally scaled collector fleet,
+ScribeSpanReceiver.scala:42-56). Store state is stacked with a leading
+[n_shards] dim sharded over the axis; batches likewise. The fused
+per-shard ingest is exactly store/device.ingest_step; the summary that
+the sampler/query layer needs crosses shards via ICI collectives only.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from zipkin_tpu.ops import moments as M
+from zipkin_tpu.store import device as dev
+
+
+def _stack_states(config: dev.StoreConfig, n: int):
+    one = dev.init_state(config)
+    return jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + x.shape), one)
+
+
+def _summarize(state: dev.StoreState, axis: str) -> Dict[str, jnp.ndarray]:
+    """Cross-shard global aggregates, computed inside shard_map."""
+    # Counters and additive sketches ride a psum.
+    spans_seen = jax.lax.psum(state.counters["spans_seen"], axis)
+    svc_counts = jax.lax.psum(state.svc_span_counts, axis)
+    svc_hist = jax.lax.psum(state.svc_hist, axis)
+    cms_counts = jax.lax.psum(state.cms_trace_spans, axis)
+    ann_svc_counts = jax.lax.psum(state.ann_svc_counts, axis)
+    # HLL merge is an elementwise max.
+    hll_regs = jax.lax.pmax(state.hll_traces, axis)
+    # Moments combine is associative+commutative but not "+": gather the
+    # per-shard banks and tree-combine.
+    banks = jax.lax.all_gather(state.dep_moments, axis)  # [n, S*S, 5]
+    dep_moments = M.reduce_moments(banks, axis=0)
+    return {
+        "spans_seen": spans_seen,
+        "svc_span_counts": svc_counts,
+        "svc_hist": svc_hist,
+        "cms_trace_spans": cms_counts,
+        "ann_svc_counts": ann_svc_counts,
+        "hll_traces": hll_regs,
+        "dep_moments": dep_moments,
+    }
+
+
+def make_sharded_ingest(mesh: Mesh, axis: str = "shard"):
+    """Build the jitted sharded step:
+
+    (stacked_states [n,...], stacked_batches [n,...]) →
+        (stacked_states, global summary replicated)
+    """
+
+    def shard_fn(state, batch):
+        # shard_map hands us blocks with the leading shard dim of size 1.
+        state = jax.tree.map(lambda x: x[0], state)
+        batch = jax.tree.map(lambda x: x[0], batch)
+        new_state = dev.ingest_step.__wrapped__(state, batch)
+        summary = _summarize(new_state, axis)
+        new_state = jax.tree.map(lambda x: x[None], new_state)
+        return new_state, summary
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+class ShardedStore:
+    """Host handle for an n-shard device store.
+
+    Round-robins host batches across shards (callers feeding from
+    multiple ingest processes would instead target their local shard).
+    """
+
+    def __init__(self, mesh: Mesh, config: dev.StoreConfig, axis: str = "shard"):
+        self.mesh = mesh
+        self.axis = axis
+        self.config = config
+        self.n = mesh.shape[axis]
+        sharding = NamedSharding(mesh, P(axis))
+        self.states = jax.device_put(_stack_states(config, self.n), sharding)
+        self.step = make_sharded_ingest(mesh, axis)
+        self.last_summary = None
+
+    def ingest(self, device_batches) -> Dict[str, np.ndarray]:
+        """device_batches: pytree stacked [n_shards, ...]."""
+        self.states, summary = self.step(self.states, device_batches)
+        self.last_summary = summary
+        return summary
+
+
+def global_summary(states, mesh: Mesh, axis: str = "shard"):
+    """One-off collective summary over stacked states (no ingest)."""
+
+    def fn(state):
+        state = jax.tree.map(lambda x: x[0], state)
+        return _summarize(state, axis)
+
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(axis),), out_specs=P(), check_vma=False
+    )
+    return jax.jit(mapped)(states)
+
+
+def stack_batches(batches) -> Tuple:
+    """Host: list of n DeviceBatch → stacked pytree [n, ...]."""
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)

@@ -1,0 +1,70 @@
+"""Trace-id sampling: the vectorized threshold test.
+
+Reference semantics (zipkin-sampler/.../Sampler.scala:39-48): keep a
+trace iff ``rate == 1`` or ``t > Long.MaxValue * (1 - rate)`` where ``t``
+is ``abs(traceId)`` (with ``Long.MinValue`` mapped to ``Long.MaxValue``).
+Because trace ids are uniform random 64-bit ints, this passes an
+unbiased ``rate`` fraction and is *consistent*: every collector makes
+the same decision for the same trace id at the same rate.
+
+The debug override (SpanSamplerFilter.scala:40-47: spans with the debug
+flag always pass) is part of ``sample_mask``.
+
+The float→threshold conversion happens once on the host in float64
+(``rate_to_threshold``); the device compares 64-bit ints exactly, so no
+TPU float64 is needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+LONG_MAX = (1 << 63) - 1
+LONG_MIN = -(1 << 63)
+
+
+def rate_to_threshold(rate: float) -> int:
+    """Host: sample rate in [0,1] → int64 threshold (exclusive lower bound)."""
+    rate = min(max(float(rate), 0.0), 1.0)
+    # float64 LONG_MAX rounds to 2^63; clamp back into int64 range.
+    return min(int(LONG_MAX * (1.0 - rate)), LONG_MAX)
+
+
+def sample_mask(trace_ids, debug, threshold):
+    """Device: keep-mask for a batch.
+
+    ``trace_ids`` int64, ``debug`` bool, ``threshold`` int64 scalar from
+    ``rate_to_threshold`` (0 keeps everything).
+    """
+    tids = jnp.asarray(trace_ids, jnp.int64)
+    t = jnp.where(tids == LONG_MIN, LONG_MAX, jnp.abs(tids))
+    return jnp.asarray(debug, bool) | (threshold <= 0) | (t > threshold)
+
+
+class Sampler:
+    """Host-side stateful wrapper with counters (Sampler.scala:27).
+
+    The rate is a plain attribute (the Var analogue); the adaptive
+    controller updates it.
+    """
+
+    def __init__(self, rate: float = 1.0):
+        self.rate = rate
+        self.allowed = 0
+        self.denied = 0
+
+    @property
+    def threshold(self) -> int:
+        return rate_to_threshold(self.rate)
+
+    def __call__(self, trace_id: int) -> bool:
+        if self.rate >= 1.0:
+            self.allowed += 1
+            return True
+        t = LONG_MAX if trace_id == LONG_MIN else abs(trace_id)
+        allow = t > self.threshold
+        if allow:
+            self.allowed += 1
+        else:
+            self.denied += 1
+        return allow

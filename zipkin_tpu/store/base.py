@@ -31,6 +31,15 @@ class StorageException(RuntimeError):
     """Raised by stores on write/read failure (storage/util SpanStoreException)."""
 
 
+def as_bytes(v) -> bytes:
+    """Canonical byte form of a binary-annotation value for comparisons."""
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    return bytes(v)
+
+
 @dataclass(frozen=True)
 class IndexedTraceId:
     """A trace id with the index timestamp that matched (Index.scala:29)."""

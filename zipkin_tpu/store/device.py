@@ -1,0 +1,674 @@
+"""Device-resident columnar span store: state pytree + fused kernels.
+
+The TPU replacement for the reference's scatter-indexes-into-a-DB design
+(CassieSpanStore.scala:283-321 writes one batch per column family per
+span batch; 5 index ops per span). Here a span batch is uploaded once as
+padded columnar arrays and **one jitted ``ingest_step`` launch** updates:
+
+- the span/annotation/binary-annotation ring buffers (the store, TTL by
+  eviction — the analogue of Cassandra's span TTL, CassieSpanStore:47),
+- the dependency-link Moments bank (streaming ZipkinAggregateJob),
+- per-service latency histograms (p50/p95/p99 queries),
+- per-service span counts, span-name presence, top-annotation counters
+  (ServiceNames/SpanNames/TopAnnotations column families),
+- a HyperLogLog of distinct trace ids and a count-min of spans/trace,
+- ingest counters feeding the adaptive sampler.
+
+Queries are separate jitted kernels over the ring columns (filter → sort
+→ limit on device; the host only receives the k winners).
+
+State carries 64-bit ids/timestamps (x64 mode); all sketch state is
+32-bit. Static configuration (capacities) is pytree aux data so jit
+retraces only when shapes actually change.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from zipkin_tpu.columnar.schema import SpanBatch
+from zipkin_tpu.models.constants import FIRST_USER_ANNOTATION_ID
+from zipkin_tpu.ops import cms, hll, join
+from zipkin_tpu.ops import moments as M
+from zipkin_tpu.ops import quantile as Q
+from zipkin_tpu.ops.hashing import dev_split64
+
+I64_MAX = np.int64(2**63 - 1)
+I64_MIN = np.int64(-(2**63))
+NO_TS = -1
+
+
+class StoreConfig(NamedTuple):
+    """Static store geometry (hashable → usable as a jit static arg)."""
+
+    capacity: int = 1 << 16  # span ring rows
+    ann_capacity: int = 1 << 18
+    bann_capacity: int = 1 << 17
+    max_services: int = 256
+    max_span_names: int = 2048
+    max_annotation_values: int = 4096
+    max_binary_keys: int = 1024
+    cms_depth: int = 4
+    cms_width: int = 1 << 16
+    hll_p: int = 14
+    # 2048 buckets at alpha=0.01 cover ~1 µs .. ~10^17 µs; fewer buckets
+    # silently clip long durations into the top bucket.
+    quantile_buckets: int = 2048
+    quantile_alpha: float = 0.01
+
+
+def _ring(n, dtype, fill=0):
+    return jnp.full((n,), fill, dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class StoreState:
+    """The carried state pytree. All arrays; config is static aux."""
+
+    config: StoreConfig
+
+    # -- span ring ------------------------------------------------------
+    trace_id: jnp.ndarray
+    span_id: jnp.ndarray
+    parent_id: jnp.ndarray
+    name_id: jnp.ndarray  # original-case span-name dictionary id
+    name_lc_id: jnp.ndarray  # lowercased id for matching; -1 = empty name
+    service_id: jnp.ndarray  # owning service (server-preferred); -1 none
+    ts_cs: jnp.ndarray
+    ts_cr: jnp.ndarray
+    ts_sr: jnp.ndarray
+    ts_ss: jnp.ndarray
+    ts_first: jnp.ndarray
+    ts_last: jnp.ndarray
+    duration: jnp.ndarray
+    flags: jnp.ndarray
+    indexable: jnp.ndarray  # bool: should_index() computed on host
+    row_gid: jnp.ndarray  # global row id occupying each slot; -1 empty
+    write_pos: jnp.ndarray  # scalar i64: total spans ever written
+
+    # -- annotation ring ------------------------------------------------
+    ann_gid: jnp.ndarray  # global span row the annotation belongs to; -1
+    ann_ts: jnp.ndarray
+    ann_value_id: jnp.ndarray
+    ann_service_id: jnp.ndarray
+    ann_endpoint_id: jnp.ndarray
+    ann_write_pos: jnp.ndarray
+
+    # -- binary-annotation ring -----------------------------------------
+    bann_gid: jnp.ndarray
+    bann_key_id: jnp.ndarray
+    bann_value_id: jnp.ndarray
+    bann_type: jnp.ndarray
+    bann_service_id: jnp.ndarray
+    bann_endpoint_id: jnp.ndarray
+    bann_write_pos: jnp.ndarray
+
+    # -- streaming aggregate state (never evicted) ----------------------
+    dep_moments: jnp.ndarray  # [S*S, 5] f32 — exact DependencyLink moments
+    svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
+    svc_span_counts: jnp.ndarray  # [S] f32
+    ann_svc_counts: jnp.ndarray  # [S] f32 — services seen on any annotation
+    name_presence: jnp.ndarray  # [S, N] f32 — (ann-service, span-name)
+    ann_value_counts: jnp.ndarray  # [S, A] f32 — top annotations per service
+    bann_key_counts: jnp.ndarray  # [S, K] f32 — top binary keys per service
+    hll_traces: jnp.ndarray  # [2^p] i32 — distinct trace ids
+    cms_trace_spans: jnp.ndarray  # [depth, width] i32 — spans per trace
+    ts_min: jnp.ndarray  # scalar i64 — earliest ts seen (ingest wall)
+    ts_max: jnp.ndarray  # scalar i64
+    counters: Dict[str, jnp.ndarray] = field(default_factory=dict)
+
+    _FIELDS = (
+        "trace_id", "span_id", "parent_id", "name_id", "name_lc_id",
+        "service_id", "ts_cs", "ts_cr", "ts_sr", "ts_ss", "ts_first",
+        "ts_last", "duration", "flags", "indexable", "row_gid", "write_pos",
+        "ann_gid", "ann_ts", "ann_value_id", "ann_service_id",
+        "ann_endpoint_id", "ann_write_pos",
+        "bann_gid", "bann_key_id", "bann_value_id", "bann_type",
+        "bann_service_id", "bann_endpoint_id", "bann_write_pos",
+        "dep_moments", "svc_hist", "svc_span_counts", "ann_svc_counts",
+        "name_presence", "ann_value_counts", "bann_key_counts",
+        "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
+    )
+
+    def tree_flatten(self):
+        return tuple(getattr(self, f) for f in self._FIELDS), self.config
+
+    @classmethod
+    def tree_unflatten(cls, config, children):
+        return cls(config, *children)
+
+    def replace(self, **kw) -> "StoreState":
+        return replace(self, **kw)
+
+
+def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
+    c = config
+    S = c.max_services
+    return StoreState(
+        config=c,
+        trace_id=_ring(c.capacity, jnp.int64),
+        span_id=_ring(c.capacity, jnp.int64),
+        parent_id=_ring(c.capacity, jnp.int64),
+        name_id=_ring(c.capacity, jnp.int32),
+        name_lc_id=_ring(c.capacity, jnp.int32, -1),
+        service_id=_ring(c.capacity, jnp.int32, -1),
+        ts_cs=_ring(c.capacity, jnp.int64, NO_TS),
+        ts_cr=_ring(c.capacity, jnp.int64, NO_TS),
+        ts_sr=_ring(c.capacity, jnp.int64, NO_TS),
+        ts_ss=_ring(c.capacity, jnp.int64, NO_TS),
+        ts_first=_ring(c.capacity, jnp.int64, NO_TS),
+        ts_last=_ring(c.capacity, jnp.int64, NO_TS),
+        duration=_ring(c.capacity, jnp.int64, NO_TS),
+        flags=_ring(c.capacity, jnp.int32),
+        indexable=_ring(c.capacity, jnp.bool_, False),
+        row_gid=_ring(c.capacity, jnp.int64, -1),
+        write_pos=jnp.int64(0),
+        ann_gid=_ring(c.ann_capacity, jnp.int64, -1),
+        ann_ts=_ring(c.ann_capacity, jnp.int64, NO_TS),
+        ann_value_id=_ring(c.ann_capacity, jnp.int32, -1),
+        ann_service_id=_ring(c.ann_capacity, jnp.int32, -1),
+        ann_endpoint_id=_ring(c.ann_capacity, jnp.int32, -1),
+        ann_write_pos=jnp.int64(0),
+        bann_gid=_ring(c.bann_capacity, jnp.int64, -1),
+        bann_key_id=_ring(c.bann_capacity, jnp.int32, -1),
+        bann_value_id=_ring(c.bann_capacity, jnp.int32, -1),
+        bann_type=_ring(c.bann_capacity, jnp.int32),
+        bann_service_id=_ring(c.bann_capacity, jnp.int32, -1),
+        bann_endpoint_id=_ring(c.bann_capacity, jnp.int32, -1),
+        bann_write_pos=jnp.int64(0),
+        # Counting state is int32: float32 scatter-adds of 1.0 silently
+        # freeze at 2^24 (~16.7M), far below the 1B-span target. int32 is
+        # exact to 2.1e9 and psum-able. Only the Moments bank stays f32
+        # (its combine adds batch-sized increments, not +1s).
+        dep_moments=jnp.zeros((S * S, M.N_FIELDS), jnp.float32),
+        svc_hist=Q.init(
+            shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
+            dtype=jnp.int32,
+        ).counts,
+        svc_span_counts=jnp.zeros(S, jnp.int32),
+        ann_svc_counts=jnp.zeros(S, jnp.int32),
+        name_presence=jnp.zeros((S, c.max_span_names), jnp.int32),
+        ann_value_counts=jnp.zeros((S, c.max_annotation_values), jnp.int32),
+        bann_key_counts=jnp.zeros((S, c.max_binary_keys), jnp.int32),
+        hll_traces=hll.init(c.hll_p).registers,
+        cms_trace_spans=cms.init(c.cms_depth, c.cms_width).counts,
+        ts_min=jnp.int64(I64_MAX),
+        ts_max=jnp.int64(I64_MIN),
+        counters={
+            "spans_seen": jnp.int64(0),
+            "anns_seen": jnp.int64(0),
+            "banns_seen": jnp.int64(0),
+            "batches": jnp.int64(0),
+        },
+    )
+
+
+def svc_histogram(state: StoreState) -> Q.LogHistogram:
+    c = state.config
+    gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
+    return Q.LogHistogram(state.svc_hist, gamma, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Device batch (padded, fixed shape)
+# ---------------------------------------------------------------------------
+
+
+class DeviceBatch(NamedTuple):
+    """A SpanBatch padded to static shape + host-computed index columns."""
+
+    trace_id: jnp.ndarray
+    span_id: jnp.ndarray
+    parent_id: jnp.ndarray
+    name_id: jnp.ndarray
+    name_lc_id: jnp.ndarray
+    service_id: jnp.ndarray
+    ts_cs: jnp.ndarray
+    ts_cr: jnp.ndarray
+    ts_sr: jnp.ndarray
+    ts_ss: jnp.ndarray
+    ts_first: jnp.ndarray
+    ts_last: jnp.ndarray
+    duration: jnp.ndarray
+    flags: jnp.ndarray
+    has_parent: jnp.ndarray
+    indexable: jnp.ndarray
+    n_spans: jnp.ndarray
+
+    ann_span_idx: jnp.ndarray
+    ann_ts: jnp.ndarray
+    ann_value_id: jnp.ndarray
+    ann_service_id: jnp.ndarray
+    ann_endpoint_id: jnp.ndarray
+    n_anns: jnp.ndarray
+
+    bann_span_idx: jnp.ndarray
+    bann_key_id: jnp.ndarray
+    bann_value_id: jnp.ndarray
+    bann_type: jnp.ndarray
+    bann_service_id: jnp.ndarray
+    bann_endpoint_id: jnp.ndarray
+    n_banns: jnp.ndarray
+
+
+def _pad(a: np.ndarray, n: int, fill=0, dtype=None) -> np.ndarray:
+    dtype = dtype or a.dtype
+    out = np.full(n, fill, dtype)
+    out[: len(a)] = a
+    return out
+
+
+def make_device_batch(
+    batch: SpanBatch,
+    name_lc_id: np.ndarray,
+    indexable: np.ndarray,
+    pad_spans: int,
+    pad_anns: int,
+    pad_banns: int,
+) -> DeviceBatch:
+    """Host: pad a SpanBatch (+ index columns) to static shapes.
+
+    ``name_lc_id`` is the lowercased span-name dictionary id (-1 for empty
+    names); ``indexable`` is store.base.should_index computed per span.
+    """
+    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
+
+    if batch.n_spans > pad_spans or batch.n_annotations > pad_anns:
+        raise ValueError("batch larger than device batch padding")
+    if batch.n_binary > pad_banns:
+        raise ValueError("batch larger than device batch padding")
+    f = batch.flags.astype(np.int32)
+    return DeviceBatch(
+        trace_id=_pad(batch.trace_id, pad_spans),
+        span_id=_pad(batch.span_id, pad_spans),
+        parent_id=_pad(batch.parent_id, pad_spans),
+        name_id=_pad(batch.name_id, pad_spans),
+        name_lc_id=_pad(np.asarray(name_lc_id, np.int32), pad_spans, -1),
+        service_id=_pad(batch.service_id, pad_spans, -1),
+        ts_cs=_pad(batch.ts_cs, pad_spans, NO_TS),
+        ts_cr=_pad(batch.ts_cr, pad_spans, NO_TS),
+        ts_sr=_pad(batch.ts_sr, pad_spans, NO_TS),
+        ts_ss=_pad(batch.ts_ss, pad_spans, NO_TS),
+        ts_first=_pad(batch.ts_first, pad_spans, NO_TS),
+        ts_last=_pad(batch.ts_last, pad_spans, NO_TS),
+        duration=_pad(batch.duration, pad_spans, NO_TS),
+        flags=_pad(f, pad_spans),
+        has_parent=_pad(
+            (f & int(FLAG_HAS_PARENT)).astype(bool), pad_spans, False
+        ),
+        indexable=_pad(np.asarray(indexable, bool), pad_spans, False),
+        n_spans=np.int32(batch.n_spans),
+        ann_span_idx=_pad(batch.ann_span_idx, pad_anns),
+        ann_ts=_pad(batch.ann_ts, pad_anns, NO_TS),
+        ann_value_id=_pad(batch.ann_value_id, pad_anns, -1),
+        ann_service_id=_pad(batch.ann_service_id, pad_anns, -1),
+        ann_endpoint_id=_pad(batch.ann_endpoint_id, pad_anns, -1),
+        n_anns=np.int32(batch.n_annotations),
+        bann_span_idx=_pad(batch.bann_span_idx, pad_banns),
+        bann_key_id=_pad(batch.bann_key_id, pad_banns, -1),
+        bann_value_id=_pad(batch.bann_value_id, pad_banns, -1),
+        bann_type=_pad(batch.bann_type.astype(np.int32), pad_banns),
+        bann_service_id=_pad(batch.bann_service_id, pad_banns, -1),
+        bann_endpoint_id=_pad(batch.bann_endpoint_id, pad_banns, -1),
+        n_banns=np.int32(batch.n_binary),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ingest_step — ONE fused launch per batch
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
+    c = state.config
+    S = c.max_services
+    P = b.trace_id.shape[0]
+    PA = b.ann_ts.shape[0]
+    PB = b.bann_key_id.shape[0]
+
+    mask = jnp.arange(P) < b.n_spans
+    mask_a = jnp.arange(PA) < b.n_anns
+    mask_b = jnp.arange(PB) < b.n_banns
+
+    # -- span ring writes ----------------------------------------------
+    gids = state.write_pos + jnp.arange(P, dtype=jnp.int64)
+    slots = (gids % c.capacity).astype(jnp.int32)
+    widx = jnp.where(mask, slots, c.capacity)  # OOB rows dropped
+    upd = {}
+    for col in (
+        "trace_id", "span_id", "parent_id", "name_id", "name_lc_id",
+        "service_id", "ts_cs", "ts_cr", "ts_sr", "ts_ss", "ts_first",
+        "ts_last", "duration", "flags", "indexable",
+    ):
+        upd[col] = getattr(state, col).at[widx].set(getattr(b, col), mode="drop")
+    upd["row_gid"] = state.row_gid.at[widx].set(gids, mode="drop")
+    upd["write_pos"] = state.write_pos + b.n_spans.astype(jnp.int64)
+
+    # -- annotation ring writes ----------------------------------------
+    a_gids = state.ann_write_pos + jnp.arange(PA, dtype=jnp.int64)
+    a_slots = (a_gids % c.ann_capacity).astype(jnp.int32)
+    a_widx = jnp.where(mask_a, a_slots, c.ann_capacity)
+    span_gid_of_ann = state.write_pos + b.ann_span_idx.astype(jnp.int64)
+    upd["ann_gid"] = state.ann_gid.at[a_widx].set(
+        jnp.where(mask_a, span_gid_of_ann, -1), mode="drop"
+    )
+    for col in ("ann_ts", "ann_value_id", "ann_service_id", "ann_endpoint_id"):
+        upd[col] = getattr(state, col).at[a_widx].set(getattr(b, col), mode="drop")
+    upd["ann_write_pos"] = state.ann_write_pos + b.n_anns.astype(jnp.int64)
+
+    bb_gids = state.bann_write_pos + jnp.arange(PB, dtype=jnp.int64)
+    bb_slots = (bb_gids % c.bann_capacity).astype(jnp.int32)
+    bb_widx = jnp.where(mask_b, bb_slots, c.bann_capacity)
+    span_gid_of_bann = state.write_pos + b.bann_span_idx.astype(jnp.int64)
+    upd["bann_gid"] = state.bann_gid.at[bb_widx].set(
+        jnp.where(mask_b, span_gid_of_bann, -1), mode="drop"
+    )
+    for col in (
+        "bann_key_id", "bann_value_id", "bann_type", "bann_service_id",
+        "bann_endpoint_id",
+    ):
+        upd[col] = getattr(state, col).at[bb_widx].set(getattr(b, col), mode="drop")
+    upd["bann_write_pos"] = state.bann_write_pos + b.n_banns.astype(jnp.int64)
+
+    # -- dependency links: within-batch parent join --------------------
+    # (trace_id, parent_id) probe against (trace_id, span_id) build —
+    # the streaming form of ZipkinAggregateJob.scala:26-38.
+    probe_valid = mask & b.has_parent
+    found, parent_svc = join.lookup(
+        (b.trace_id, b.span_id), mask, b.service_id,
+        (b.trace_id, b.parent_id), probe_valid,
+    )
+    child_svc = b.service_id
+    link_ok = (
+        found
+        & (parent_svc >= 0)
+        & (child_svc >= 0)
+        & (parent_svc < S)
+        & (child_svc < S)
+        & (b.duration >= 0)
+    )
+    link_id = jnp.where(
+        link_ok, parent_svc.astype(jnp.int32) * S + child_svc, 0
+    )
+    batch_moments = M.segment_moments(
+        b.duration.astype(jnp.float32), link_id, S * S, valid=link_ok
+    )
+    upd["dep_moments"] = M.combine(state.dep_moments, batch_moments)
+
+    # -- per-service latency histogram ---------------------------------
+    hist = svc_histogram(state)
+    svc_ok = mask & (b.service_id >= 0) & (b.service_id < S) & (b.duration >= 0)
+    hist = Q.update_grouped(
+        hist, jnp.clip(b.service_id, 0, S - 1), b.duration.astype(jnp.float32),
+        valid=svc_ok,
+    )
+    upd["svc_hist"] = hist.counts
+
+    # -- counters / presence matrices ----------------------------------
+    svc_pad = jnp.where(mask & (b.service_id >= 0) & (b.service_id < S),
+                        b.service_id, S)
+    upd["svc_span_counts"] = (
+        jnp.concatenate([state.svc_span_counts, jnp.zeros(1, jnp.int32)])
+        .at[svc_pad].add(1)[:S]
+    )
+    a_svc = b.ann_service_id
+    a_svc_pad = jnp.where(mask_a & (a_svc >= 0) & (a_svc < S), a_svc, S)
+    upd["ann_svc_counts"] = (
+        jnp.concatenate([state.ann_svc_counts, jnp.zeros(1, jnp.int32)])
+        .at[a_svc_pad].add(1)[:S]
+    )
+
+    # span-name presence keyed by annotation-host service (the semantics
+    # of getSpanNames: names of indexed spans for a service).
+    ann_name = b.name_id[b.ann_span_idx]  # batch-local gather
+    ann_name_lc = b.name_lc_id[b.ann_span_idx]
+    ann_indexable = b.indexable[b.ann_span_idx]
+    np_ok = (
+        mask_a & (a_svc >= 0) & (a_svc < S) & ann_indexable
+        & (ann_name_lc >= 0) & (ann_name >= 0) & (ann_name < c.max_span_names)
+    )
+    np_flat = jnp.where(np_ok, a_svc * c.max_span_names + ann_name,
+                        S * c.max_span_names)
+    upd["name_presence"] = (
+        jnp.concatenate([state.name_presence.reshape(-1),
+                         jnp.zeros(1, jnp.int32)])
+        .at[np_flat].add(1)[:-1].reshape(S, c.max_span_names)
+    )
+
+    # top annotations per service (user annotations only).
+    av_ok = (
+        mask_a & (a_svc >= 0) & (a_svc < S)
+        & (b.ann_value_id >= FIRST_USER_ANNOTATION_ID)
+        & (b.ann_value_id < c.max_annotation_values)
+    )
+    av_flat = jnp.where(av_ok, a_svc * c.max_annotation_values + b.ann_value_id,
+                        S * c.max_annotation_values)
+    upd["ann_value_counts"] = (
+        jnp.concatenate([state.ann_value_counts.reshape(-1),
+                         jnp.zeros(1, jnp.int32)])
+        .at[av_flat].add(1)[:-1].reshape(S, c.max_annotation_values)
+    )
+
+    bk_svc = b.bann_service_id
+    bk_ok = (
+        mask_b & (bk_svc >= 0) & (bk_svc < S)
+        & (b.bann_key_id >= 0) & (b.bann_key_id < c.max_binary_keys)
+    )
+    bk_flat = jnp.where(bk_ok, bk_svc * c.max_binary_keys + b.bann_key_id,
+                        S * c.max_binary_keys)
+    upd["bann_key_counts"] = (
+        jnp.concatenate([state.bann_key_counts.reshape(-1),
+                         jnp.zeros(1, jnp.int32)])
+        .at[bk_flat].add(1)[:-1].reshape(S, c.max_binary_keys)
+    )
+
+    # -- probabilistic state -------------------------------------------
+    t_hi, t_lo = dev_split64(b.trace_id)
+    upd["hll_traces"] = hll.update(
+        hll.HyperLogLog(state.hll_traces), t_hi, t_lo, valid=mask
+    ).registers
+    upd["cms_trace_spans"] = cms.update(
+        cms.CountMin(state.cms_trace_spans), t_hi, t_lo,
+        weights=mask.astype(state.cms_trace_spans.dtype),
+    ).counts
+
+    # -- time range + counters -----------------------------------------
+    firsts = jnp.where(mask & (b.ts_first >= 0), b.ts_first, I64_MAX)
+    lasts = jnp.where(mask & (b.ts_last >= 0), b.ts_last, I64_MIN)
+    upd["ts_min"] = jnp.minimum(state.ts_min, firsts.min())
+    upd["ts_max"] = jnp.maximum(state.ts_max, lasts.max())
+    upd["counters"] = {
+        "spans_seen": state.counters["spans_seen"] + b.n_spans,
+        "anns_seen": state.counters["anns_seen"] + b.n_anns,
+        "banns_seen": state.counters["banns_seen"] + b.n_banns,
+        "batches": state.counters["batches"] + 1,
+    }
+
+    return state.replace(**upd)
+
+
+# ---------------------------------------------------------------------------
+# Query kernels
+# ---------------------------------------------------------------------------
+
+
+def _ann_span_slot(state: StoreState):
+    """Per annotation-ring row: (span slot, row-still-live mask)."""
+    c = state.config
+    slot = (state.ann_gid % c.capacity).astype(jnp.int32)
+    slot = jnp.clip(slot, 0, c.capacity - 1)
+    live = (state.ann_gid >= 0) & (state.row_gid[slot] == state.ann_gid)
+    return slot, live
+
+
+def _bann_span_slot(state: StoreState):
+    c = state.config
+    slot = (state.bann_gid % c.capacity).astype(jnp.int32)
+    slot = jnp.clip(slot, 0, c.capacity - 1)
+    live = (state.bann_gid >= 0) & (state.row_gid[slot] == state.bann_gid)
+    return slot, live
+
+
+def _dedup_topk_by_ts(gid, tid, ts, valid, k: int):
+    """Dedup candidate span rows by gid, then take top-k by ts desc.
+
+    Returns (tids[k], tss[k], valid[k]). Mirrors the in-memory store's
+    "sort matched spans by last timestamp desc, truncate" semantics.
+    """
+    # Sort by gid then mark first occurrence.
+    n = gid.shape[0]
+    gid_key = jnp.where(valid, gid, I64_MAX)
+    order = jnp.argsort(gid_key)
+    g_sorted = gid_key[order]
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), g_sorted[1:] != g_sorted[:-1]]
+    ) & (g_sorted != I64_MAX)
+    rep_valid = first
+    ts_s, tid_s = ts[order], tid[order]
+    # Top-k by ts desc among representatives. Valid ts are >= 0, so -ts
+    # never overflows; invalid rows get I64_MAX and sort last.
+    neg_key = jnp.where(rep_valid, -ts_s, I64_MAX)
+    sel = jnp.argsort(neg_key)[:k]
+    return tid_s[sel], ts_s[sel], rep_valid[sel]
+
+
+@partial(jax.jit, static_argnums=(4,))
+def query_trace_ids_by_service(
+    state: StoreState, svc_id, name_lc_id, end_ts, limit: int
+):
+    """Spans of a service (any annotation host), optional span-name match,
+    last_ts <= end_ts, top ``limit`` by last_ts desc.
+
+    Reference semantics: getTraceIdsByName (SpanStore.scala /
+    CassieSpanStore.scala:366) with index ts = span last timestamp.
+    """
+    slot, live = _ann_span_slot(state)
+    ok = live & (state.ann_service_id == svc_id)
+    ok &= state.indexable[slot]
+    ok &= (name_lc_id < 0) | (state.name_lc_id[slot] == name_lc_id)
+    ts = state.ts_last[slot]
+    ok &= (ts >= 0) & (ts <= end_ts)
+    return _dedup_topk_by_ts(state.ann_gid, state.trace_id[slot], ts, ok, limit)
+
+
+@partial(jax.jit, static_argnums=(7,))
+def query_trace_ids_by_annotation(
+    state: StoreState, svc_id, ann_value_id, bann_key_id, bann_value_id,
+    bann_value_id2, end_ts, limit: int,
+):
+    """Annotation-index query (CassieSpanStore AnnotationsIndex semantics).
+
+    Matches spans of ``svc_id`` that carry the user annotation
+    ``ann_value_id``, OR a binary annotation with ``bann_key_id``
+    (and one of ``bann_value_id``/``bann_value_id2`` if >= 0 — two slots
+    because the host dictionary may hold a value in both str and bytes
+    form). Pass -1 to disable either side.
+    """
+    c = state.config
+    # Annotation-value candidates.
+    a_slot, a_live = _ann_span_slot(state)
+    a_ok = (
+        a_live
+        & (state.ann_value_id == ann_value_id) & (ann_value_id >= 0)
+        & state.indexable[a_slot]
+    )
+    a_svc_ok = _span_has_service(state, a_slot, svc_id)
+    a_ok &= a_svc_ok
+    a_ts = state.ts_last[a_slot]
+    a_ok &= (a_ts >= 0) & (a_ts <= end_ts)
+    # Binary-annotation candidates.
+    b_slot, b_live = _bann_span_slot(state)
+    value_free = (bann_value_id < 0) & (bann_value_id2 < 0)
+    value_hit = (
+        ((bann_value_id >= 0) & (state.bann_value_id == bann_value_id))
+        | ((bann_value_id2 >= 0) & (state.bann_value_id == bann_value_id2))
+    )
+    b_ok = (
+        b_live
+        & (state.bann_key_id == bann_key_id) & (bann_key_id >= 0)
+        & (value_free | value_hit)
+        & state.indexable[b_slot]
+    )
+    b_ok &= _span_has_service(state, b_slot, svc_id)
+    b_ts = state.ts_last[b_slot]
+    b_ok &= (b_ts >= 0) & (b_ts <= end_ts)
+
+    gid = jnp.concatenate([state.ann_gid, state.bann_gid])
+    tid = jnp.concatenate([state.trace_id[a_slot], state.trace_id[b_slot]])
+    ts = jnp.concatenate([a_ts, b_ts])
+    ok = jnp.concatenate([a_ok, b_ok])
+    return _dedup_topk_by_ts(gid, tid, ts, ok, limit)
+
+
+def _span_has_service(state: StoreState, span_slot, svc_id):
+    """Per-row: does the span at ``span_slot`` have ``svc_id`` among its
+    annotation services? Computed via a per-slot service bitset-free
+    membership pass over the annotation ring."""
+    # Build: which slots have an annotation with svc_id.
+    a_slot, a_live = _ann_span_slot(state)
+    hit = a_live & (state.ann_service_id == svc_id)
+    per_slot = jnp.zeros(state.config.capacity + 1, bool)
+    per_slot = per_slot.at[jnp.where(hit, a_slot, state.config.capacity)].set(
+        True, mode="drop"
+    )[:-1]
+    return per_slot[span_slot]
+
+
+@jax.jit
+def query_durations(state: StoreState, sorted_qids):
+    """Per queried trace id: (found, min first_ts, max last_ts).
+
+    ``sorted_qids`` must be ascending (host sorts). Mirrors
+    getTracesDuration (Index.scala:26): duration = max(last) - min(first).
+    """
+    nq = sorted_qids.shape[0]
+    live = state.row_gid >= 0
+    pos = jnp.searchsorted(sorted_qids, state.trace_id)
+    pos_c = jnp.clip(pos, 0, nq - 1)
+    match = live & (sorted_qids[pos_c] == state.trace_id)
+    seg = jnp.where(match, pos_c, nq)
+    has_ts = match & (state.ts_first >= 0)
+    firsts = jnp.where(has_ts, state.ts_first, I64_MAX)
+    lasts = jnp.where(has_ts, state.ts_last, I64_MIN)
+    min_first = (
+        jnp.full(nq + 1, I64_MAX, jnp.int64).at[seg].min(firsts, mode="drop")[:nq]
+    )
+    max_last = (
+        jnp.full(nq + 1, I64_MIN, jnp.int64).at[seg].max(lasts, mode="drop")[:nq]
+    )
+    found = (
+        jnp.zeros(nq + 1, bool).at[seg].max(has_ts, mode="drop")[:nq]
+    )
+    return found, min_first, max_last
+
+
+@jax.jit
+def query_trace_membership(state: StoreState, sorted_qids):
+    """Bool masks: (span rows, ann rows, bann rows) belonging to the ids."""
+    nq = sorted_qids.shape[0]
+    live = state.row_gid >= 0
+    pos = jnp.clip(jnp.searchsorted(sorted_qids, state.trace_id), 0, nq - 1)
+    span_in = live & (sorted_qids[pos] == state.trace_id)
+    a_slot, a_live = _ann_span_slot(state)
+    ann_in = a_live & span_in[a_slot]
+    b_slot, b_live = _bann_span_slot(state)
+    bann_in = b_live & span_in[b_slot]
+    return span_in, ann_in, bann_in
+
+
+@jax.jit
+def query_service_stats(state: StoreState):
+    """(service present mask, span-name presence, dep moments) snapshot."""
+    return (
+        state.ann_svc_counts > 0,
+        state.name_presence > 0,
+        state.dep_moments,
+    )

@@ -28,6 +28,7 @@ from zipkin_tpu.store.base import (
     IndexedTraceId,
     SpanStore,
     TraceIdDuration,
+    as_bytes,
     should_index,
 )
 
@@ -117,7 +118,7 @@ class InMemorySpanStore(SpanStore):
                 continue
             if value is not None:
                 ok = any(
-                    b.key == annotation and _as_bytes(b.value) == value
+                    b.key == annotation and as_bytes(b.value) == value
                     for b in s.binary_annotations
                 )
             else:
@@ -155,10 +156,3 @@ class InMemorySpanStore(SpanStore):
     def get_span_names(self, service: str) -> Set[str]:
         return {s.name for s in self._spans_for_service(service) if s.name}
 
-
-def _as_bytes(v) -> bytes:
-    if isinstance(v, bytes):
-        return v
-    if isinstance(v, str):
-        return v.encode("utf-8")
-    return bytes(v)

@@ -1,0 +1,418 @@
+"""TpuSpanStore — the SpanStore SPI backed by the device columnar store.
+
+The host side owns the dictionaries (strings never reach the device),
+computes index policy bits (store.base.should_index, lowercased
+span-name ids), pads batches, and decodes query results back into span
+objects; everything between upload and the k winning rows runs on device
+(store/device.py).
+
+Plays the role of CassieSpanStore (the production backend,
+zipkin-cassandra/.../CassieSpanStore.scala:55) and passes the same
+conformance suite as the in-memory reference store.
+
+Beyond the SPI it exposes the analytics the reference computes offline
+(dependencies, percentiles, top annotations, cardinality) straight from
+the streaming sketch state — see the ``analytics``-section methods.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from zipkin_tpu.columnar.dictionary import DictionarySet
+from zipkin_tpu.columnar.encode import SpanCodec
+from zipkin_tpu.columnar.schema import SpanBatch
+from zipkin_tpu.models.constants import CORE_ANNOTATIONS
+from zipkin_tpu.models.dependencies import Dependencies, DependencyLink, Moments
+from zipkin_tpu.models.span import Span
+from zipkin_tpu.ops import hll
+from zipkin_tpu.ops import quantile as Q
+from zipkin_tpu.store import device as dev
+from zipkin_tpu.columnar.encode import to_signed64
+from zipkin_tpu.store.base import (
+    IndexedTraceId,
+    SpanStore,
+    TraceIdDuration,
+    as_bytes,
+    should_index,
+)
+
+_BATCH_MIN = 64
+
+
+def _next_pow2(n: int) -> int:
+    p = _BATCH_MIN
+    while p < n:
+        p <<= 1
+    return p
+
+
+class TpuSpanStore(SpanStore):
+    def __init__(self, config: Optional[dev.StoreConfig] = None,
+                 codec: Optional[SpanCodec] = None):
+        self.config = config or dev.StoreConfig()
+        self.codec = codec or SpanCodec()
+        self.state = dev.init_state(self.config)
+        self._lock = threading.Lock()
+        self.ttls: Dict[int, float] = {}
+        # name_id -> lowercased-name id, maintained incrementally.
+        self._name_lc: Dict[int, int] = {}
+
+    @property
+    def dicts(self) -> DictionarySet:
+        return self.codec.dicts
+
+    # -- writes ---------------------------------------------------------
+
+    def _name_lc_ids(self, batch: SpanBatch) -> np.ndarray:
+        d = self.dicts
+        out = np.empty(batch.n_spans, np.int32)
+        for i, nid in enumerate(batch.name_id):
+            nid = int(nid)
+            lc = self._name_lc.get(nid)
+            if lc is None:
+                name = d.span_names.decode(nid)
+                lc = -1 if name == "" else d.span_names.encode(name.lower())
+                self._name_lc[nid] = lc
+            out[i] = lc
+        return out
+
+    # ItemQueue-aligned chunk bound: keeps jit shapes bounded and batches
+    # well under any ring capacity.
+    MAX_CHUNK = 4096
+
+    def apply(self, spans: Sequence[Span]) -> None:
+        if not spans:
+            return
+        with self._lock:
+            for span in spans:
+                self.ttls[span.trace_id] = 1.0
+            chunk = min(self.MAX_CHUNK, self.config.capacity // 2 or 1)
+            for i in range(0, len(spans), chunk):
+                part = list(spans[i:i + chunk])
+                batch = self.codec.encode(part)
+                indexable = np.fromiter(
+                    (should_index(s) for s in part), bool, len(part)
+                )
+                self.write_batch(batch, indexable)
+
+    def write_batch(self, batch: SpanBatch, indexable: np.ndarray) -> None:
+        """Upload one columnar batch and run the fused ingest step.
+
+        A batch larger than a ring would scatter colliding slot indices in
+        one launch (result order implementation-defined on TPU) — callers
+        must chunk; ``apply`` does.
+        """
+        c = self.config
+        if (batch.n_spans > c.capacity
+                or batch.n_annotations > c.ann_capacity
+                or batch.n_binary > c.bann_capacity):
+            raise ValueError(
+                f"batch ({batch.n_spans} spans / {batch.n_annotations} anns "
+                f"/ {batch.n_binary} banns) exceeds ring capacity "
+                f"({c.capacity}/{c.ann_capacity}/{c.bann_capacity}); "
+                "split into smaller batches"
+            )
+        db = dev.make_device_batch(
+            batch,
+            name_lc_id=self._name_lc_ids(batch),
+            indexable=indexable,
+            pad_spans=_next_pow2(batch.n_spans),
+            pad_anns=_next_pow2(batch.n_annotations),
+            pad_banns=_next_pow2(batch.n_binary),
+        )
+        self.state = dev.ingest_step(self.state, db)
+
+    def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
+        with self._lock:
+            self.ttls[trace_id] = ttl_seconds
+
+    def get_time_to_live(self, trace_id: int) -> float:
+        with self._lock:
+            return self.ttls[trace_id]
+
+    # -- id lookups -----------------------------------------------------
+
+    def _svc_id(self, service_name: str) -> Optional[int]:
+        return self.dicts.services.get(service_name.lower())
+
+    def get_trace_ids_by_name(
+        self, service_name: str, span_name: Optional[str],
+        end_ts: int, limit: int,
+    ) -> List[IndexedTraceId]:
+        svc = self._svc_id(service_name)
+        if svc is None or limit <= 0:
+            return []
+        if span_name is not None:
+            name_lc = self.dicts.span_names.get(span_name.lower())
+            if name_lc is None:
+                return []
+        else:
+            name_lc = -1
+        tids, tss, ok = dev.query_trace_ids_by_service(
+            self.state, svc, name_lc, end_ts, limit
+        )
+        return [
+            IndexedTraceId(int(t), int(ts))
+            for t, ts, v in zip(np.asarray(tids), np.asarray(tss), np.asarray(ok))
+            if v
+        ]
+
+    def get_trace_ids_by_annotation(
+        self, service_name: str, annotation: str, value: Optional[bytes],
+        end_ts: int, limit: int,
+    ) -> List[IndexedTraceId]:
+        if annotation in CORE_ANNOTATIONS or limit <= 0:
+            return []
+        svc = self._svc_id(service_name)
+        if svc is None:
+            return []
+        d = self.dicts
+        bann_key = d.binary_keys.get(annotation)
+        bann_key = -1 if bann_key is None else bann_key
+        if value is not None:
+            # Value given: only binary annotations with that exact value
+            # match (memory.py / CassieSpanStore binary index semantics).
+            # The dictionary keys values in their original python form, so
+            # probe both the bytes and the decoded-str representation.
+            ann_value = -1
+            vb = as_bytes(value)
+            bann_value = d.binary_values.get(vb)
+            try:
+                bann_value2 = d.binary_values.get(vb.decode("utf-8"))
+            except UnicodeDecodeError:
+                bann_value2 = None
+            bann_value = -1 if bann_value is None else bann_value
+            bann_value2 = -1 if bann_value2 is None else bann_value2
+            if (bann_value < 0 and bann_value2 < 0) or bann_key < 0:
+                return []
+        else:
+            ann_value = d.annotations.get(annotation)
+            ann_value = -1 if ann_value is None else ann_value
+            bann_value = bann_value2 = -1
+            if ann_value < 0 and bann_key < 0:
+                return []
+        tids, tss, ok = dev.query_trace_ids_by_annotation(
+            self.state, svc, ann_value, bann_key, bann_value, bann_value2,
+            end_ts, limit,
+        )
+        return [
+            IndexedTraceId(int(t), int(ts))
+            for t, ts, v in zip(np.asarray(tids), np.asarray(tss), np.asarray(ok))
+            if v
+        ]
+
+    # -- trace reads ----------------------------------------------------
+
+    @staticmethod
+    def _canon_ids(trace_ids: Sequence[int]) -> Dict[int, int]:
+        """signed-canonical id → caller's original id (ids ≥ 2^63 arrive
+        unsigned on the wire but are stored signed)."""
+        return {to_signed64(t): t for t in trace_ids}
+
+    def _sorted_qids(self, trace_ids: Sequence[int]) -> np.ndarray:
+        return np.sort(
+            np.asarray([to_signed64(t) for t in trace_ids], np.int64)
+        )
+
+    def traces_exist(self, trace_ids: Sequence[int]) -> Set[int]:
+        if not trace_ids:
+            return set()
+        canon = self._canon_ids(trace_ids)
+        qids = self._sorted_qids(trace_ids)
+        span_in, _, _ = dev.query_trace_membership(self.state, qids)
+        present_tids = np.asarray(self.state.trace_id)[np.asarray(span_in)]
+        return {
+            canon[t] for t in np.unique(present_tids).tolist() if t in canon
+        }
+
+    def get_spans_by_trace_ids(self, trace_ids: Sequence[int]) -> List[List[Span]]:
+        if not trace_ids:
+            return []
+        qids = self._sorted_qids(trace_ids)
+        span_in, ann_in, bann_in = dev.query_trace_membership(self.state, qids)
+        rows, spans = self._materialize(
+            np.asarray(span_in), np.asarray(ann_in), np.asarray(bann_in)
+        )
+        by_tid: Dict[int, List[Span]] = {}
+        for row, span in zip(rows, spans):
+            by_tid.setdefault(span.trace_id, []).append(span)
+        # One result per query id, duplicates included — matching the
+        # in-memory reference store's behavior.
+        return [
+            by_tid[to_signed64(tid)]
+            for tid in trace_ids
+            if to_signed64(tid) in by_tid
+        ]
+
+    def _materialize(
+        self, span_mask: np.ndarray, ann_mask: np.ndarray, bann_mask: np.ndarray
+    ) -> Tuple[np.ndarray, List[Span]]:
+        """Gather masked ring rows to host and decode to Span objects,
+        ordered by insertion (global row id)."""
+        st = self.state
+        rows = np.flatnonzero(span_mask)
+        if rows.size == 0:
+            return rows, []
+        gids = np.asarray(st.row_gid)[rows]
+        order = np.argsort(gids, kind="stable")
+        rows = rows[order]
+        gids = gids[order]
+        gid_to_local = {int(g): i for i, g in enumerate(gids)}
+
+        def col(name, idx):
+            return np.asarray(getattr(st, name))[idx]
+
+        n = rows.size
+        batch = SpanBatch.empty(n, 0, 0)
+        for c in ("trace_id", "span_id", "parent_id", "name_id", "service_id",
+                  "ts_cs", "ts_cr", "ts_sr", "ts_ss", "ts_first", "ts_last",
+                  "duration"):
+            setattr(batch, c, col(c, rows))
+        batch.flags = col("flags", rows).astype(np.uint8)
+
+        # Annotations, in ring-age order so per-span insert order survives.
+        arows = np.flatnonzero(ann_mask)
+        if arows.size:
+            a_age = self._ring_age(arows, int(st.ann_write_pos),
+                                   self.config.ann_capacity)
+            arows = arows[np.argsort(a_age, kind="stable")]
+            a_gid = col("ann_gid", arows)
+            batch.ann_span_idx = np.array(
+                [gid_to_local[int(g)] for g in a_gid], np.int32
+            )
+            batch.ann_ts = col("ann_ts", arows)
+            batch.ann_value_id = col("ann_value_id", arows)
+            batch.ann_service_id = col("ann_service_id", arows)
+            batch.ann_endpoint_id = col("ann_endpoint_id", arows)
+        brows = np.flatnonzero(bann_mask)
+        if brows.size:
+            b_age = self._ring_age(brows, int(st.bann_write_pos),
+                                   self.config.bann_capacity)
+            brows = brows[np.argsort(b_age, kind="stable")]
+            b_gid = col("bann_gid", brows)
+            batch.bann_span_idx = np.array(
+                [gid_to_local[int(g)] for g in b_gid], np.int32
+            )
+            batch.bann_key_id = col("bann_key_id", brows)
+            batch.bann_value_id = col("bann_value_id", brows)
+            batch.bann_type = col("bann_type", brows).astype(np.uint8)
+            batch.bann_service_id = col("bann_service_id", brows)
+            batch.bann_endpoint_id = col("bann_endpoint_id", brows)
+        return rows, self.codec.decode(batch)
+
+    @staticmethod
+    def _ring_age(slots: np.ndarray, write_pos: int, capacity: int) -> np.ndarray:
+        """Insertion order of ring slots: oldest → 0. Valid for live rows."""
+        head = write_pos % capacity
+        return (slots - head) % capacity
+
+    def get_traces_duration(
+        self, trace_ids: Sequence[int]
+    ) -> List[TraceIdDuration]:
+        if not trace_ids:
+            return []
+        canon = self._canon_ids(trace_ids)
+        qids = self._sorted_qids(trace_ids)
+        found, min_first, max_last = dev.query_durations(self.state, qids)
+        found = np.asarray(found)
+        min_first = np.asarray(min_first)
+        max_last = np.asarray(max_last)
+        by_tid = {
+            canon[int(q)]: TraceIdDuration(canon[int(q)], int(mx - mn), int(mn))
+            for q, f, mn, mx in zip(qids, found, min_first, max_last)
+            if f
+        }
+        return [by_tid[t] for t in trace_ids if t in by_tid]
+
+    # -- name catalogs --------------------------------------------------
+
+    def get_all_service_names(self) -> Set[str]:
+        present = np.asarray(self.state.ann_svc_counts) > 0
+        d = self.dicts.services
+        return {
+            d.decode(i) for i in np.flatnonzero(present)
+            if i < len(d) and d.decode(i)
+        }
+
+    def get_span_names(self, service: str) -> Set[str]:
+        svc = self._svc_id(service)
+        if svc is None:
+            return set()
+        row = np.asarray(self.state.name_presence[svc]) > 0
+        d = self.dicts.span_names
+        return {
+            d.decode(i) for i in np.flatnonzero(row)
+            if i < len(d) and d.decode(i)
+        }
+
+    # -- analytics (the reference's offline aggregates, served live) ----
+
+    def get_dependencies(self) -> Dependencies:
+        """DependencyLinks from the streaming Moments bank — the live
+        equivalent of Aggregates.getDependencies (Aggregates.scala:31)."""
+        S = self.config.max_services
+        bank = np.asarray(self.state.dep_moments, np.float64)
+        nz = np.flatnonzero(bank[:, 0] > 0)
+        d = self.dicts.services
+        links = []
+        for li in nz:
+            parent, child = divmod(int(li), S)
+            if parent >= len(d) or child >= len(d):
+                continue
+            links.append(
+                DependencyLink(
+                    d.decode(parent), d.decode(child),
+                    Moments.from_central(*bank[li]),
+                )
+            )
+        ts_min = int(self.state.ts_min)
+        ts_max = int(self.state.ts_max)
+        if not links and ts_min > ts_max:
+            return Dependencies.zero()
+        return Dependencies(float(ts_min), float(ts_max), tuple(links))
+
+    def service_duration_quantiles(
+        self, service: str, qs: Sequence[float]
+    ) -> Optional[List[float]]:
+        svc = self._svc_id(service)
+        if svc is None:
+            return None
+        hist = dev.svc_histogram(self.state)
+        one = Q.LogHistogram(hist.counts[svc], hist.gamma, hist.min_value)
+        return [float(Q.quantile(one, q)) for q in qs]
+
+    def top_annotations(self, service: str, k: int = 10) -> List[Tuple[str, int]]:
+        svc = self._svc_id(service)
+        if svc is None:
+            return []
+        row = np.asarray(self.state.ann_value_counts[svc])
+        order = np.argsort(-row)[:k]
+        d = self.dicts.annotations
+        return [
+            (d.decode(int(i)), int(row[i]))
+            for i in order
+            if row[i] > 0 and i < len(d)
+        ]
+
+    def top_binary_keys(self, service: str, k: int = 10) -> List[Tuple[str, int]]:
+        svc = self._svc_id(service)
+        if svc is None:
+            return []
+        row = np.asarray(self.state.bann_key_counts[svc])
+        order = np.argsort(-row)[:k]
+        d = self.dicts.binary_keys
+        return [
+            (d.decode(int(i)), int(row[i])) for i in order
+            if row[i] > 0 and i < len(d)
+        ]
+
+    def estimated_unique_traces(self) -> float:
+        return float(hll.estimate(hll.HyperLogLog(self.state.hll_traces)))
+
+    def counters(self) -> Dict[str, float]:
+        return {k: float(v) for k, v in self.state.counters.items()}
