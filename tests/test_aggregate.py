@@ -1,0 +1,153 @@
+"""Aggregation parity: python oracle vs streaming bank vs device recompute."""
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.aggregate import (
+    IncrementalAggregator,
+    aggregate_spans,
+    recompute_dependencies,
+)
+from zipkin_tpu.models.span import Annotation, Endpoint, Span
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.tracegen import generate_traces
+
+WEB = Endpoint(1, 80, "web")
+API = Endpoint(2, 80, "api")
+DB = Endpoint(3, 80, "db")
+
+CFG = StoreConfig(
+    capacity=1 << 11, ann_capacity=1 << 13, bann_capacity=1 << 12,
+    max_services=32, max_span_names=128, max_annotation_values=128,
+    max_binary_keys=32, cms_width=1 << 10, hll_p=8, quantile_buckets=256,
+)
+
+
+def rpc(tid, sid, parent, client, server, t0, t1):
+    return Span(tid, "op", sid, parent, (
+        Annotation(t0, "cs", client),
+        Annotation(t0 + 1, "sr", server),
+        Annotation(t1 - 1, "ss", server),
+        Annotation(t1, "cr", client),
+    ))
+
+
+def split_halves(tid, sid, parent, client, server, t0, t1):
+    """The same RPC as two half spans (client-reported + server-reported),
+    exercising the merge-before-join step."""
+    c = Span(tid, "op", sid, parent,
+             (Annotation(t0, "cs", client), Annotation(t1, "cr", client)))
+    s = Span(tid, "op", sid, parent,
+             (Annotation(t0 + 1, "sr", server), Annotation(t1 - 1, "ss", server)))
+    return [c, s]
+
+
+class TestOracle:
+    def test_basic_join(self):
+        spans = [
+            rpc(1, 1, None, WEB, API, 0, 1000),
+            rpc(1, 2, 1, API, DB, 100, 400),
+            rpc(1, 3, 1, API, DB, 500, 600),
+        ]
+        deps = aggregate_spans(spans)
+        links = {(l.parent, l.child): l for l in deps.links}
+        assert set(links) == {("api", "db")}
+        m = links[("api", "db")].duration_moments
+        assert m.count == 2
+        assert m.mean == pytest.approx((300 + 100) / 2)
+
+    def test_merges_split_halves_before_join(self):
+        spans = (
+            split_halves(1, 1, None, WEB, API, 0, 1000)
+            + split_halves(1, 2, 1, API, DB, 100, 400)
+        )
+        deps = aggregate_spans(spans)
+        links = {(l.parent, l.child) for l in deps.links}
+        # Parent's merged service name is server-preferred: "api".
+        assert links == {("api", "db")}
+
+    def test_orphan_children_ignored(self):
+        deps = aggregate_spans([rpc(1, 2, 99, API, DB, 0, 100)])
+        assert deps.links == ()
+
+    def test_time_range(self):
+        deps = aggregate_spans([
+            rpc(1, 1, None, WEB, API, 1000, 2000),
+            rpc(1, 2, 1, API, DB, 1100, 1200),
+        ])
+        assert deps.start_time == 1100 and deps.end_time == 1200
+
+
+class TestStreamingVsOracleParity:
+    def test_tracegen_parity(self):
+        store = TpuSpanStore(CFG)
+        all_spans = []
+        for spans in generate_traces(n_traces=12, max_depth=5, n_services=5):
+            store.apply(spans)
+            all_spans.extend(spans)
+        want = {
+            (l.parent, l.child): l.duration_moments
+            for l in aggregate_spans(all_spans).links
+        }
+        got = {
+            (l.parent, l.child): l.duration_moments
+            for l in store.get_dependencies().links
+        }
+        assert set(got) == set(want)
+        for k in want:
+            assert got[k].count == want[k].count, k
+            assert got[k].mean == pytest.approx(want[k].mean, rel=1e-4), k
+
+    def test_device_recompute_matches_streaming_when_in_retention(self):
+        store = TpuSpanStore(CFG)
+        for spans in generate_traces(n_traces=8, max_depth=4, n_services=4):
+            store.apply(spans)
+        streaming = {
+            (l.parent, l.child): l.duration_moments
+            for l in store.get_dependencies().links
+        }
+        recomputed = {
+            (l.parent, l.child): l.duration_moments
+            for l in recompute_dependencies(store).links
+        }
+        assert set(streaming) == set(recomputed)
+        for k in streaming:
+            assert streaming[k].count == recomputed[k].count
+
+
+class TestIncrementalAggregator:
+    def test_batched_fold_matches_one_shot(self):
+        spans = [
+            rpc(t, 1, None, WEB, API, t * 1000, t * 1000 + 500)
+            for t in range(1, 9)
+        ] + [
+            rpc(t, 2, 1, API, DB, t * 1000 + 10, t * 1000 + 100)
+            for t in range(1, 9)
+        ]
+        inc = IncrementalAggregator(batch_size=3)
+        inc.offer(spans)
+        one = aggregate_spans(spans)
+        got = {(l.parent, l.child): l.duration_moments for l in inc.result().links}
+        want = {(l.parent, l.child): l.duration_moments for l in one.links}
+        assert set(got) == set(want)
+        for k in want:
+            assert got[k].count == want[k].count
+            assert got[k].mean == pytest.approx(want[k].mean)
+
+    def test_resume_skips_already_aggregated(self):
+        inc = IncrementalAggregator(resume_ts=5000)
+        inc.offer([
+            rpc(1, 1, None, WEB, API, 1000, 2000),  # before watermark
+            rpc(1, 2, 1, API, DB, 1100, 1200),
+        ])
+        assert inc.result().links == ()
+
+    def test_resume_from_watermark(self):
+        inc = IncrementalAggregator()
+        assert inc.resume_from() is None
+        inc.offer([
+            rpc(1, 1, None, WEB, API, 1000, 2000),
+            rpc(1, 2, 1, API, DB, 1100, 1200),
+        ])
+        assert inc.resume_from() == 1200

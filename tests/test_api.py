@@ -1,0 +1,165 @@
+"""HTTP API tests: route parity, query extraction, ingest doors, pinning.
+
+ApiServer.handle is driven directly (no sockets) except one end-to-end
+socket test over the real ThreadingHTTPServer.
+"""
+
+import json
+
+import pytest
+
+from zipkin_tpu.api import ApiServer, extract_query, make_server
+from zipkin_tpu.api.server import serve_forever_in_thread
+from zipkin_tpu.ingest.collector import Collector
+from zipkin_tpu.ingest.receiver import span_to_json
+from zipkin_tpu.models.span import Annotation, BinaryAnnotation, Endpoint, Span
+from zipkin_tpu.query.request import Order
+from zipkin_tpu.query.service import QueryService
+from zipkin_tpu.store.memory import InMemorySpanStore
+from zipkin_tpu.wire.thrift import span_to_scribe_message
+
+WEB = Endpoint(0x01010101, 80, "web")
+API = Endpoint(0x02020202, 80, "api")
+
+
+def rpc(tid, sid, parent, cs, cr, name="call"):
+    return Span(tid, name, sid, parent, (
+        Annotation(cs, "cs", WEB),
+        Annotation(cs + 1, "sr", API),
+        Annotation(cr - 1, "ss", API),
+        Annotation(cr, "cr", WEB),
+        Annotation(cs + 5, "hot", API),
+    ), (BinaryAnnotation("k", b"v", host=API),))
+
+
+@pytest.fixture
+def app():
+    store = InMemorySpanStore()
+    collector = Collector(store)
+    api = ApiServer(QueryService(store), collector)
+    store.apply([rpc(1, 10, None, 100, 200)])
+    store.apply([rpc(2, 10, None, 1100, 1300, name="other")])
+    return api
+
+
+class TestQueryExtractor:
+    def test_basic(self):
+        qr = extract_query({"serviceName": "api", "limit": "5"})
+        assert qr.service_name == "api" and qr.limit == 5
+        assert qr.span_name is None and qr.order is Order.NONE
+
+    def test_span_name_all_is_none(self):
+        assert extract_query({"serviceName": "a", "spanName": "all"}).span_name is None
+        assert extract_query({"serviceName": "a", "spanName": "x"}).span_name == "x"
+
+    def test_annotation_query_language(self):
+        qr = extract_query({
+            "serviceName": "a",
+            "annotationQuery": "error and http.code=500 and retry",
+        })
+        assert set(qr.annotations) == {"error", "retry"}
+        assert [(b.key, b.value) for b in qr.binary_annotations] == [
+            ("http.code", b"500")
+        ]
+
+    def test_no_service_is_none(self):
+        assert extract_query({}) is None
+
+
+class TestRoutes:
+    def test_services(self, app):
+        status, body = app.handle("GET", "/api/services", {})
+        assert status == 200 and body == ["api", "web"]
+
+    def test_spans(self, app):
+        status, body = app.handle("GET", "/api/spans", {"serviceName": "api"})
+        assert status == 200 and body == ["call", "other"]
+
+    def test_spans_requires_service(self, app):
+        status, _ = app.handle("GET", "/api/spans", {})
+        assert status == 400
+
+    def test_query(self, app):
+        status, body = app.handle(
+            "GET", "/api/query",
+            {"serviceName": "api", "timestamp": str(10**18)},
+        )
+        assert status == 200
+        assert set(body["traceIds"]) == {1, 2}
+        assert len(body["summaries"]) == 2
+
+    def test_trace_fetch(self, app):
+        status, body = app.handle("GET", "/api/trace/1", {})
+        assert status == 200
+        assert body[0]["traceId"] == 1
+        status2, body2 = app.handle("GET", "/api/get/1", {})
+        assert status2 == 200 and body2 == body
+
+    def test_trace_missing_404(self, app):
+        status, _ = app.handle("GET", "/api/trace/999", {})
+        assert status == 404
+
+    def test_dependencies_shape(self, app):
+        status, body = app.handle("GET", "/api/dependencies", {})
+        assert status == 200 and "links" in body
+
+    def test_pin_cycle(self, app):
+        status, body = app.handle("POST", "/api/pin/1/true", {})
+        assert status == 200 and body["pinned"] is True
+        _, q = app.handle("GET", "/api/is_pinned/1", {})
+        assert q["pinned"] is True
+        app.handle("POST", "/api/pin/1/false", {})
+        _, q2 = app.handle("GET", "/api/is_pinned/1", {})
+        assert q2["pinned"] is False
+
+    def test_health_and_metrics(self, app):
+        assert app.handle("GET", "/health", {})[0] == 200
+        status, metrics = app.handle("GET", "/metrics", {})
+        assert status == 200 and "collector.queue_size" in metrics
+
+    def test_unknown_404(self, app):
+        assert app.handle("GET", "/api/nope", {})[0] == 404
+
+
+class TestIngestDoors:
+    def test_json_ingest(self, app):
+        span = rpc(77, 1, None, 50, 60)
+        body = json.dumps([span_to_json(span)]).encode()
+        status, resp = app.handle("POST", "/api/spans", {}, body)
+        assert status == 202
+        app.collector.flush()
+        status, got = app.handle("GET", "/api/trace/77", {})
+        assert status == 200 and got[0]["traceId"] == 77
+
+    def test_scribe_ingest(self, app):
+        span = rpc(88, 1, None, 50, 60)
+        body = json.dumps([
+            {"category": "zipkin", "message": span_to_scribe_message(span)}
+        ]).encode()
+        status, resp = app.handle("POST", "/scribe", {}, body)
+        assert status == 200 and resp["result"] == "OK"
+        app.collector.flush()
+        assert app.handle("GET", "/api/trace/88", {})[0] == 200
+
+
+class TestSocketEndToEnd:
+    def test_real_http_roundtrip(self, app):
+        import urllib.request
+
+        server = make_server(app, host="127.0.0.1", port=0)
+        port = server.server_address[1]
+        serve_forever_in_thread(server)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/services", timeout=5
+            ) as r:
+                assert json.loads(r.read()) == ["api", "web"]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/spans",
+                data=json.dumps([span_to_json(rpc(5, 1, None, 1, 2))]).encode(),
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.status == 202
+        finally:
+            server.shutdown()

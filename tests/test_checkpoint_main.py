@@ -1,0 +1,95 @@
+"""Checkpoint save/restore + composed mains smoke tests."""
+
+import numpy as np
+import pytest
+
+from zipkin_tpu import checkpoint
+from zipkin_tpu.models.span import Annotation, BinaryAnnotation, Endpoint, Span
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.tpu import TpuSpanStore
+
+CFG = StoreConfig(
+    capacity=1 << 9, ann_capacity=1 << 11, bann_capacity=1 << 10,
+    max_services=16, max_span_names=64, max_annotation_values=64,
+    max_binary_keys=16, cms_width=1 << 9, hll_p=6, quantile_buckets=128,
+)
+
+WEB = Endpoint(1, 80, "web")
+API = Endpoint(2, 80, "api")
+
+
+def rpc(tid, sid, parent, t0, t1):
+    return Span(tid, "op", sid, parent, (
+        Annotation(t0, "cs", WEB),
+        Annotation(t0 + 1, "sr", API),
+        Annotation(t1 - 1, "ss", API),
+        Annotation(t1, "cr", WEB),
+    ), (BinaryAnnotation("k", b"v", host=API),))
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        store = TpuSpanStore(CFG)
+        store.apply([rpc(1, 1, None, 100, 200), rpc(1, 2, 1, 110, 150)])
+        store.set_time_to_live(1, 777.0)
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(store, path)
+
+        restored = checkpoint.load(path)
+        # Queries behave identically on the restored store.
+        assert restored.get_spans_by_trace_ids([1]) == \
+            store.get_spans_by_trace_ids([1])
+        assert restored.get_all_service_names() == {"web", "api"}
+        assert restored.get_time_to_live(1) == 777.0
+        assert restored.counters() == store.counters()
+        got = {(l.parent, l.child) for l in restored.get_dependencies().links}
+        assert got == {(l.parent, l.child) for l in store.get_dependencies().links}
+
+    def test_restored_store_accepts_writes(self, tmp_path):
+        store = TpuSpanStore(CFG)
+        store.apply([rpc(1, 1, None, 100, 200)])
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(store, path)
+        restored = checkpoint.load(path)
+        restored.apply([rpc(2, 1, None, 300, 400)])
+        assert restored.traces_exist([1, 2]) == {1, 2}
+        # Dictionary ids survived: the same service maps to the same id.
+        assert restored.dicts.services.get("api") == store.dicts.services.get("api")
+
+    def test_atomic_overwrite(self, tmp_path):
+        store = TpuSpanStore(CFG)
+        store.apply([rpc(1, 1, None, 100, 200)])
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(store, path)
+        store.apply([rpc(2, 1, None, 300, 400)])
+        checkpoint.save(store, path)  # overwrite in place
+        restored = checkpoint.load(path)
+        assert restored.traces_exist([1, 2]) == {1, 2}
+
+
+class TestMains:
+    def test_tracegen_main_tpu_roundtrip(self):
+        from zipkin_tpu.main.tracegen import run
+
+        assert run(n_traces=3, max_depth=4, use_tpu=True, verbose=False)
+
+    def test_tracegen_main_memory_roundtrip(self):
+        from zipkin_tpu.main.tracegen import run
+
+        assert run(n_traces=3, max_depth=4, use_tpu=False, verbose=False)
+
+    def test_example_build_app_and_seed(self):
+        from zipkin_tpu.main.example import build_app, build_parser, seed
+
+        args = build_parser().parse_args(
+            ["--memory-store", "--seed-traces", "2"]
+        )
+        store, collector, api = build_app(args)
+        seed(collector, 2)
+        status, services = api.handle("GET", "/api/services", {})
+        assert status == 200 and services
+        # Runtime-adjustable sample rate (HttpVar parity).
+        status, body = api.handle("POST", "/vars/sampleRate", {}, b"0.25")
+        assert status == 200 and body["sampleRate"] == 0.25
+        assert collector.sampler.rate == 0.25
+        collector.close()

@@ -1,0 +1,216 @@
+"""Ingest runtime tests: thrift wire roundtrip, queue backpressure,
+scribe receiver decode + TRY_LATER, collector pipeline with sampling."""
+
+import threading
+import time
+
+import pytest
+
+from zipkin_tpu.ingest import (
+    Collector,
+    ItemQueue,
+    JsonReceiver,
+    QueueFullException,
+    ResultCode,
+    ScribeReceiver,
+)
+from zipkin_tpu.ingest.receiver import span_from_json, span_to_json
+from zipkin_tpu.models.span import (
+    Annotation,
+    AnnotationType,
+    BinaryAnnotation,
+    Endpoint,
+    Span,
+)
+from zipkin_tpu.store.memory import InMemorySpanStore
+from zipkin_tpu.wire.thrift import (
+    scribe_message_to_span,
+    span_from_bytes,
+    span_to_bytes,
+    span_to_scribe_message,
+    spans_from_bytes,
+)
+
+EP = Endpoint(0x7F000001, 8080, "some-service")
+
+SPAN = Span(
+    trace_id=-(2**62) + 7,
+    name="get /widgets",
+    id=12345,
+    parent_id=-99,
+    annotations=(
+        Annotation(1_700_000_000_000_000, "cs", EP),
+        Annotation(1_700_000_000_500_000, "cr", EP),
+        Annotation(1_700_000_000_100_000, "custom", None),
+    ),
+    binary_annotations=(
+        BinaryAnnotation("http.uri", "/widgets", AnnotationType.STRING, EP),
+        BinaryAnnotation("blob", b"\x00\xff", AnnotationType.BYTES, None),
+        BinaryAnnotation("count", 42, AnnotationType.I32, None),
+        BinaryAnnotation("ok", True, AnnotationType.BOOL, None),
+    ),
+    debug=True,
+)
+
+
+class TestThriftWire:
+    def test_roundtrip(self):
+        data = span_to_bytes(SPAN)
+        got, pos = span_from_bytes(data)
+        assert pos == len(data)
+        assert got == SPAN
+
+    def test_concatenated_spans(self):
+        bare = Span(trace_id=1, name="x", id=2)
+        data = span_to_bytes(SPAN) + span_to_bytes(bare)
+        assert spans_from_bytes(data) == [SPAN, bare]
+
+    def test_scribe_base64_roundtrip(self):
+        msg = span_to_scribe_message(SPAN)
+        assert scribe_message_to_span(msg) == SPAN
+
+    def test_unknown_fields_skipped(self):
+        # Append an unknown i32 field id 99 before the stop byte.
+        import struct
+
+        data = span_to_bytes(SPAN)
+        patched = data[:-1] + struct.pack(">bhi", 8, 99, 7) + b"\x00"
+        got, _ = span_from_bytes(patched)
+        assert got == SPAN
+
+    def test_truncated_raises(self):
+        from zipkin_tpu.wire.thrift import ThriftError
+
+        with pytest.raises(ThriftError):
+            span_from_bytes(span_to_bytes(SPAN)[:10])
+
+
+class TestJson:
+    def test_roundtrip(self):
+        assert span_from_json(span_to_json(SPAN)) == SPAN
+
+    def test_hex_ids_accepted(self):
+        d = span_to_json(Span(trace_id=255, name="x", id=16))
+        d["traceId"], d["id"] = "ff", "10"
+        got = span_from_json(d)
+        assert got.trace_id == 255 and got.id == 16
+
+
+class TestItemQueue:
+    def test_processes_items(self):
+        seen = []
+        q = ItemQueue(seen.append, max_size=10, concurrency=2)
+        for i in range(5):
+            q.add(i)
+        q.join()
+        assert sorted(seen) == [0, 1, 2, 3, 4]
+        assert q.processed == 5
+
+    def test_queue_full_raises(self):
+        gate = threading.Event()
+        q = ItemQueue(lambda _: gate.wait(5), max_size=2, concurrency=1)
+        q.add(1)
+        time.sleep(0.1)  # let the worker pick up item 1 and block
+        q.add(2)
+        q.add(3)
+        with pytest.raises(QueueFullException):
+            q.add(4)
+        gate.set()
+        q.join()
+
+    def test_errors_counted_not_fatal(self):
+        def boom(i):
+            if i == 1:
+                raise RuntimeError("nope")
+
+        q = ItemQueue(boom, max_size=10, concurrency=1)
+        q.add(0)
+        q.add(1)
+        q.add(2)
+        q.join()
+        assert q.errors == 1 and q.processed == 2
+
+    def test_close_drains(self):
+        seen = []
+        q = ItemQueue(seen.append, max_size=100, concurrency=3)
+        for i in range(50):
+            q.add(i)
+        q.close()
+        assert len(seen) == 50
+        with pytest.raises(QueueFullException):
+            q.add(99)
+
+
+class TestScribeReceiver:
+    def test_decode_and_process(self):
+        got = []
+        r = ScribeReceiver(got.extend)
+        code = r.log([("zipkin", span_to_scribe_message(SPAN))])
+        assert code is ResultCode.OK
+        assert got == [SPAN]
+
+    def test_category_whitelist(self):
+        got = []
+        r = ScribeReceiver(got.extend)
+        assert r.log([("other", span_to_scribe_message(SPAN))]) is ResultCode.OK
+        assert got == [] and r.stats["ignored"] == 1
+
+    def test_bad_payload_counted(self):
+        got = []
+        r = ScribeReceiver(got.extend)
+        r.log([("zipkin", "!!!not-thrift!!!")])
+        assert r.stats["bad"] == 1 and got == []
+
+    def test_try_later_on_queue_full(self):
+        def full(_spans):
+            raise QueueFullException("full")
+
+        r = ScribeReceiver(full)
+        code = r.log([("zipkin", span_to_scribe_message(SPAN))])
+        assert code is ResultCode.TRY_LATER
+        assert r.stats["pushed_back"] == 1
+
+
+class TestCollector:
+    def test_end_to_end_scribe_to_store(self):
+        store = InMemorySpanStore()
+        col = Collector(store)
+        recv = ScribeReceiver(col.accept)
+        recv.log([("zipkin", span_to_scribe_message(SPAN))])
+        col.flush()
+        assert store.get_spans_by_trace_id(SPAN.trace_id) == [SPAN]
+        col.close()
+
+    def test_sampling_drops_but_debug_passes(self):
+        from zipkin_tpu.sampler.core import Sampler
+
+        store = InMemorySpanStore()
+        col = Collector(store, sampler=Sampler(0.0))
+        debug_span = Span(trace_id=5, name="d", id=1, debug=True)
+        plain_span = Span(trace_id=6, name="p", id=2)
+        col.accept([debug_span, plain_span])
+        col.flush()
+        assert store.traces_exist([5, 6]) == {5}
+        assert col.spans_dropped == 1
+
+    def test_adaptive_control_tick_moves_rate(self):
+        from zipkin_tpu.sampler.adaptive import AdaptiveConfig
+
+        store = InMemorySpanStore()
+        cfg = AdaptiveConfig(
+            target_store_rate=60.0, update_freq_s=1.0, window_s=10.0,
+            sufficient_window_s=3.0, outlier_window_s=2.0,
+        )
+        col = Collector(store, adaptive=cfg)
+        now = 1000.0
+        # Feed ~40x the target store rate for a while.
+        for tick in range(12):
+            spans = [
+                Span(trace_id=tick * 1000 + i, name="s", id=1)
+                for i in range(40)
+            ]
+            col.accept(spans)
+            col.flush()
+            col.control_tick(now)
+            now += 1.0
+        assert col.sampler.rate < 1.0

@@ -1,0 +1,237 @@
+"""Query service + TimeSkewAdjuster tests.
+
+Golden skew scenarios follow the reference's TimeSkewAdjusterSpec
+pattern: multi-service traces with known clock offsets must come back
+causally ordered. Runs against both the in-memory store and the TPU
+store (the query layer is store-agnostic).
+"""
+
+import pytest
+
+from zipkin_tpu.models.span import Annotation, BinaryAnnotation, Endpoint, Span
+from zipkin_tpu.query import (
+    BinaryAnnotationQuery,
+    Order,
+    QueryException,
+    QueryRequest,
+    QueryResponse,
+    QueryService,
+    TimeSkewAdjuster,
+)
+from zipkin_tpu.models.trace import Trace
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.memory import InMemorySpanStore
+from zipkin_tpu.store.tpu import TpuSpanStore
+
+WEB = Endpoint(0x01010101, 80, "web")
+API = Endpoint(0x02020202, 80, "api")
+DB = Endpoint(0x03030303, 80, "db")
+
+SMALL = StoreConfig(
+    capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+    max_services=32, max_span_names=128, max_annotation_values=256,
+    max_binary_keys=64, cms_width=1 << 10, hll_p=8, quantile_buckets=512,
+)
+
+
+def rpc(tid, sid, parent, client_ep, server_ep, cs, sr, ss, cr, name="call",
+        extra_ann=None, bann=None):
+    anns = [
+        Annotation(cs, "cs", client_ep),
+        Annotation(sr, "sr", server_ep),
+        Annotation(ss, "ss", server_ep),
+        Annotation(cr, "cr", client_ep),
+    ]
+    if extra_ann:
+        anns.append(extra_ann)
+    return Span(tid, name, sid, parent, tuple(anns), tuple(bann or ()))
+
+
+STORES = [
+    ("memory", InMemorySpanStore),
+    ("tpu", lambda: TpuSpanStore(SMALL)),
+]
+
+
+@pytest.mark.parametrize("label,factory", STORES)
+class TestGetTraceIds:
+    def load(self, factory):
+        store = factory()
+        # trace 1: web->api with annotation "boom" + binary {k: v1}
+        store.apply([
+            rpc(1, 10, None, WEB, API, 100, 110, 190, 200, name="index",
+                extra_ann=Annotation(150, "boom", API),
+                bann=[BinaryAnnotation("k", b"v1", host=API)]),
+        ])
+        # trace 2: web->api, later, no custom annotation
+        store.apply([
+            rpc(2, 10, None, WEB, API, 1100, 1110, 1190, 1200, name="index"),
+        ])
+        # trace 3: different span name
+        store.apply([
+            rpc(3, 10, None, WEB, API, 2100, 2110, 2190, 2200, name="other"),
+        ])
+        return QueryService(store)
+
+    def test_no_slices_by_service(self, label, factory):
+        svc = self.load(factory)
+        resp = svc.get_trace_ids(QueryRequest("api", limit=10))
+        assert set(resp.trace_ids) == {1, 2, 3}
+
+    def test_span_name_slice(self, label, factory):
+        svc = self.load(factory)
+        resp = svc.get_trace_ids(QueryRequest("api", span_name="index"))
+        assert set(resp.trace_ids) == {1, 2}
+
+    def test_annotation_slice(self, label, factory):
+        svc = self.load(factory)
+        resp = svc.get_trace_ids(QueryRequest("api", annotations=("boom",)))
+        assert resp.trace_ids == (1,)
+
+    def test_binary_annotation_slice(self, label, factory):
+        svc = self.load(factory)
+        resp = svc.get_trace_ids(QueryRequest(
+            "api", binary_annotations=(BinaryAnnotationQuery("k", b"v1"),)
+        ))
+        assert resp.trace_ids == (1,)
+
+    def test_multi_slice_intersection(self, label, factory):
+        svc = self.load(factory)
+        # span name "index" AND annotation "boom" → only trace 1.
+        resp = svc.get_trace_ids(QueryRequest(
+            "api", span_name="index", annotations=("boom",)
+        ))
+        assert resp.trace_ids == (1,)
+
+    def test_multi_slice_no_common(self, label, factory):
+        svc = self.load(factory)
+        resp = svc.get_trace_ids(QueryRequest(
+            "api", span_name="other", annotations=("boom",)
+        ))
+        assert resp.trace_ids == ()
+
+    def test_order_timestamp_desc(self, label, factory):
+        svc = self.load(factory)
+        resp = svc.get_trace_ids(QueryRequest(
+            "api", order=Order.TIMESTAMP_DESC
+        ))
+        assert resp.trace_ids == (3, 2, 1)
+
+    def test_order_duration_desc(self, label, factory):
+        store = factory()
+        store.apply([rpc(1, 10, None, WEB, API, 100, 110, 120, 400)])  # 300
+        store.apply([rpc(2, 10, None, WEB, API, 100, 110, 120, 200)])  # 100
+        store.apply([rpc(3, 10, None, WEB, API, 100, 110, 120, 900)])  # 800
+        svc = QueryService(store)
+        resp = svc.get_trace_ids(QueryRequest("api", order=Order.DURATION_DESC))
+        assert resp.trace_ids == (3, 1, 2)
+
+    def test_limit(self, label, factory):
+        svc = self.load(factory)
+        resp = svc.get_trace_ids(QueryRequest(
+            "api", limit=2, order=Order.TIMESTAMP_DESC
+        ))
+        assert resp.trace_ids == (3, 2)
+
+    def test_end_ts_pagination(self, label, factory):
+        svc = self.load(factory)
+        resp = svc.get_trace_ids(QueryRequest(
+            "api", end_ts=1500, order=Order.TIMESTAMP_DESC
+        ))
+        assert resp.trace_ids == (2, 1)
+
+    def test_missing_service_raises(self, label, factory):
+        svc = self.load(factory)
+        with pytest.raises(QueryException):
+            svc.get_trace_ids(QueryRequest(""))
+
+    def test_trace_fetch_and_summaries(self, label, factory):
+        svc = self.load(factory)
+        traces = svc.get_traces_by_ids([1])
+        assert len(traces) == 1
+        summaries = svc.get_trace_summaries_by_ids([1])
+        assert summaries and summaries[0].trace_id == 1
+        combos = svc.get_trace_combos_by_ids([1])
+        assert combos[0].summary is not None
+
+
+class TestTimeSkewAdjuster:
+    def test_skewed_server_comes_back_inside_client_interval(self):
+        # Server clock 1000 ahead: sr/ss stamped +1000.
+        span = rpc(1, 1, None, WEB, API,
+                   cs=100, sr=1150 , ss=1180, cr=200)
+        t = TimeSkewAdjuster().adjust(Trace([span]))
+        ann = t.spans[0].annotations_as_map()
+        assert 100 <= ann["sr"].timestamp <= ann["ss"].timestamp <= 200
+        # Client annotations untouched.
+        assert ann["cs"].timestamp == 100 and ann["cr"].timestamp == 200
+
+    def test_well_ordered_trace_untouched(self):
+        span = rpc(1, 1, None, WEB, API, cs=100, sr=110, ss=180, cr=200)
+        t = TimeSkewAdjuster().adjust(Trace([span]))
+        assert t.spans[0] == span
+
+    def test_skew_propagates_to_children(self):
+        # api's clock is +10000 vs web. Both the api server half of the
+        # root and api's client half of the child carry the offset.
+        root = rpc(1, 1, None, WEB, API, cs=100, sr=10150, ss=10180, cr=300)
+        child = rpc(1, 2, 1, API, DB, cs=10160, sr=10165, ss=10170, cr=10175)
+        t = TimeSkewAdjuster().adjust(Trace([root, child]))
+        spans = {s.id: s for s in t.spans}
+        root_ann = spans[1].annotations_as_map()
+        child_ann = spans[2].annotations_as_map()
+        # Causality restored: child runs inside the root's server window.
+        assert root_ann["sr"].timestamp >= root_ann["cs"].timestamp
+        assert child_ann["cs"].timestamp >= root_ann["sr"].timestamp
+        assert child_ann["cr"].timestamp <= root_ann["ss"].timestamp + 1
+
+    def test_server_longer_than_client_not_adjusted(self):
+        span = rpc(1, 1, None, WEB, API, cs=100, sr=90, ss=250, cr=200)
+        t = TimeSkewAdjuster().adjust(Trace([span]))
+        assert t.spans[0] == span
+
+    def test_client_only_span_gets_synthetic_server_half(self):
+        parent = Span(1, "p", 1, None, (
+            Annotation(100, "cs", WEB), Annotation(200, "cr", WEB),
+        ))
+        child = rpc(1, 2, 1, API, DB, cs=120, sr=130, ss=150, cr=160)
+        adj = TimeSkewAdjuster()
+        t = adj.adjust(Trace([parent, child]))
+        spans = {s.id: s for s in t.spans}
+        ann = spans[1].annotations_as_map()
+        assert "sr" in ann and "ss" in ann
+        assert ann["sr"].timestamp == 100 and ann["ss"].timestamp == 200
+        assert "TIME_SKEW_ADD_SERVER_RECV" in adj.warnings
+
+    def test_malformed_trace_without_root_passes_through(self):
+        orphan = Span(1, "x", 5, parent_id=99,
+                      annotations=(Annotation(1, "cs", WEB),))
+        t = TimeSkewAdjuster().adjust(Trace([orphan]))
+        assert list(t.spans) == [orphan]
+
+
+class TestQueryServiceAggregates:
+    def test_dependencies_null_for_memory_store(self):
+        svc = QueryService(InMemorySpanStore())
+        deps = svc.get_dependencies()
+        assert deps.links == ()
+
+    def test_dependencies_from_tpu_store(self):
+        store = TpuSpanStore(SMALL)
+        store.apply([
+            rpc(1, 1, None, WEB, API, 100, 110, 190, 200),
+            rpc(1, 2, 1, API, DB, 120, 125, 170, 180),
+        ])
+        svc = QueryService(store)
+        deps = svc.get_dependencies()
+        assert {(l.parent, l.child) for l in deps.links} == {("api", "db")}
+
+    def test_top_annotations_passthrough(self):
+        store = TpuSpanStore(SMALL)
+        store.apply([
+            rpc(1, 1, None, WEB, API, 100, 110, 190, 200,
+                extra_ann=Annotation(150, "hot-path", API)),
+        ])
+        svc = QueryService(store)
+        assert svc.get_top_annotations("api") == ["hot-path"]
+        assert QueryService(InMemorySpanStore()).get_top_annotations("api") == []

@@ -1,0 +1,20 @@
+"""Dependency aggregation: offline/batch jobs + the streaming parity.
+
+Reference: zipkin-aggregate's Scalding job (ZipkinAggregateJob.scala:10-47
+— merge span halves, join parents×children, Moments per link, monoid
+sum) and the incremental SQL aggregator (AnormAggregator.scala:32-90 —
+≤10k-span batches, resume from the last aggregated end_ts).
+
+Three forms here:
+- ``aggregate_spans``: the pure-python oracle with full merge semantics;
+- ``recompute_dependencies``: device kernel over the TPU store's ring
+  (store/device.recompute_dep_moments) — the rerunnable batch job;
+- ``IncrementalAggregator``: resumable batch-driven aggregation with the
+  reference's resume-from-MAX(end_ts) behavior.
+"""
+
+from zipkin_tpu.aggregate.job import (  # noqa: F401
+    IncrementalAggregator,
+    aggregate_spans,
+    recompute_dependencies,
+)

@@ -1,0 +1,11 @@
+"""HTTP JSON API mirroring zipkin-web's route surface.
+
+Reference routes (web/Main.scala:77-89): /api/query, /api/services,
+/api/spans, /api/top_annotations, /api/top_kv_annotations,
+/api/dependencies, /api/trace/:id (alias /api/get/:id),
+/api/is_pinned/:id, /api/pin/:id/:state — plus ingest doors
+(POST /api/spans JSON, POST /scribe) and /health and /metrics.
+"""
+
+from zipkin_tpu.api.server import ApiServer, make_server  # noqa: F401
+from zipkin_tpu.api.query_extractor import extract_query  # noqa: F401

@@ -1,0 +1,264 @@
+"""Threaded HTTP server exposing the query + ingest surface.
+
+Plays zipkin-web's server role (web/Main.scala:31-89) minus the mustache
+UI: JSON in/out, stdlib-only (ThreadingHTTPServer), fronted by the
+QueryService and Collector. Trace pinning adjusts TTL exactly like the
+reference (Handlers.scala:461-490: pin=true → webPinTtl, pin=false →
+default TTL).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qsl, urlparse
+
+from zipkin_tpu.api.query_extractor import extract_query
+from zipkin_tpu.ingest.collector import Collector
+from zipkin_tpu.ingest.receiver import (
+    JsonReceiver,
+    ResultCode,
+    ScribeReceiver,
+    span_to_json,
+)
+from zipkin_tpu.query.request import QueryException
+from zipkin_tpu.query.service import QueryService
+
+DEFAULT_PIN_TTL_S = 30 * 24 * 3600  # webPinTtl default 30 days
+DEFAULT_TTL_S = 1.0
+
+
+def _trace_json(trace):
+    return [span_to_json(s) for s in trace.spans]
+
+
+def _moments_json(m):
+    return {
+        "count": m.count, "mean": m.mean, "stddev": m.stddev,
+        "m2": m.m2, "m3": m.m3, "m4": m.m4,
+    }
+
+
+class ApiServer:
+    """Route table + handlers, decoupled from the HTTP plumbing so tests
+    can drive it without sockets."""
+
+    def __init__(self, query: QueryService, collector: Optional[Collector] = None,
+                 pin_ttl_s: float = DEFAULT_PIN_TTL_S):
+        self.query = query
+        self.collector = collector
+        self.pin_ttl_s = pin_ttl_s
+        self.scribe = (
+            ScribeReceiver(collector.accept) if collector is not None else None
+        )
+        self.json_ingest = (
+            JsonReceiver(collector.accept) if collector is not None else None
+        )
+        # Runtime-adjustable vars (HttpVar.scala:30 / the old
+        # /config/sampleRate endpoint): name → (getter, setter).
+        self.vars = {}
+        if collector is not None:
+            self.vars["sampleRate"] = (
+                lambda: collector.sampler.rate,
+                lambda v: setattr(collector.sampler, "rate", float(v)),
+            )
+
+    # -- dispatch -------------------------------------------------------
+
+    def handle(self, method: str, path: str, params: dict,
+               body: bytes = b"") -> Tuple[int, object]:
+        try:
+            return self._route(method, path, params, body)
+        except QueryException as e:
+            return 400, {"error": str(e)}
+        except KeyError as e:
+            return 404, {"error": f"not found: {e}"}
+        except (ValueError, json.JSONDecodeError) as e:
+            return 400, {"error": str(e)}
+
+    def _route(self, method, path, params, body):
+        if path == "/health":
+            return 200, {"status": "ok"}
+        if path == "/metrics":
+            return 200, self._metrics()
+        if path == "/api/query":
+            return self._query(params)
+        if path == "/api/services":
+            return 200, sorted(self.query.get_service_names())
+        if path == "/api/spans" and method == "GET":
+            return 200, sorted(self.query.get_span_names(
+                _require(params, "serviceName")))
+        if path == "/api/top_annotations":
+            return 200, self.query.get_top_annotations(
+                _require(params, "serviceName"))
+        if path == "/api/top_kv_annotations":
+            return 200, self.query.get_top_key_value_annotations(
+                _require(params, "serviceName"))
+        if path == "/api/dependencies" or re.match(r"^/api/dependencies/", path):
+            return self._dependencies(path)
+        m = re.match(r"^/api/(?:trace|get)/(-?\d+)$", path)
+        if m:
+            return self._trace(int(m.group(1)), params)
+        m = re.match(r"^/api/is_pinned/(-?\d+)$", path)
+        if m:
+            return self._is_pinned(int(m.group(1)))
+        m = re.match(r"^/api/pin/(-?\d+)/(true|false)$", path)
+        if m and method == "POST":
+            return self._pin(int(m.group(1)), m.group(2) == "true")
+        if method == "POST" and path in ("/api/spans", "/api/v1/spans"):
+            return self._ingest_json(body)
+        if method == "POST" and path == "/scribe":
+            return self._ingest_scribe(body)
+        m = re.match(r"^/vars/(\w+)$", path)
+        if m:
+            return self._var(m.group(1), method, body)
+        raise KeyError(path)
+
+    def _var(self, name: str, method: str, body: bytes):
+        getter_setter = self.vars.get(name)
+        if getter_setter is None:
+            raise KeyError(name)
+        getter, setter = getter_setter
+        if method == "POST":
+            setter(json.loads(body or b"null"))
+        return 200, {name: getter()}
+
+    # -- handlers -------------------------------------------------------
+
+    def _query(self, params):
+        qr = extract_query(params)
+        if qr is None:
+            return 400, {"error": "serviceName is required"}
+        resp = self.query.get_trace_ids(qr)
+        summaries = self.query.get_trace_summaries_by_ids(resp.trace_ids)
+        return 200, {
+            "traceIds": list(resp.trace_ids),
+            "startTs": resp.start_ts,
+            "endTs": resp.end_ts,
+            "summaries": [
+                {
+                    "traceId": s.trace_id,
+                    "startTimestamp": s.start_timestamp,
+                    "endTimestamp": s.end_timestamp,
+                    "durationMicro": s.duration_micro,
+                    "endpoints": [
+                        {"ipv4": e.ipv4, "port": e.port,
+                         "serviceName": e.service_name}
+                        for e in s.endpoints
+                    ],
+                }
+                for s in summaries
+            ],
+        }
+
+    def _trace(self, trace_id: int, params):
+        adjust = params.get("adjust_clock_skew", "true") != "false"
+        traces = self.query.get_traces_by_ids([trace_id], adjust=adjust)
+        if not traces:
+            raise KeyError(trace_id)
+        return 200, _trace_json(traces[0])
+
+    def _dependencies(self, path):
+        deps = self.query.get_dependencies()
+        return 200, {
+            "startTime": deps.start_time,
+            "endTime": deps.end_time,
+            "links": [
+                {
+                    "parent": l.parent,
+                    "child": l.child,
+                    "durationMoments": _moments_json(l.duration_moments),
+                }
+                for l in deps.links
+            ],
+        }
+
+    def _is_pinned(self, trace_id: int):
+        try:
+            ttl = self.query.get_trace_time_to_live(trace_id)
+        except KeyError:
+            raise
+        return 200, {"pinned": ttl >= self.pin_ttl_s}
+
+    def _pin(self, trace_id: int, state: bool):
+        self.query.set_trace_time_to_live(
+            trace_id, self.pin_ttl_s if state else DEFAULT_TTL_S
+        )
+        return 200, {"pinned": state}
+
+    def _ingest_json(self, body: bytes):
+        if self.json_ingest is None:
+            return 501, {"error": "no collector attached"}
+        code = self.json_ingest.post(body)
+        if code is ResultCode.TRY_LATER:
+            return 503, {"error": "try later"}
+        return 202, {"accepted": True}
+
+    def _ingest_scribe(self, body: bytes):
+        if self.scribe is None:
+            return 501, {"error": "no collector attached"}
+        entries = [
+            (e["category"], e["message"]) for e in json.loads(body)
+        ]
+        code = self.scribe.log(entries)
+        return 200, {"result": code.name}
+
+    def _metrics(self):
+        out = {}
+        if self.collector is not None:
+            out.update({
+                "collector.queue_size": self.collector.queue.size,
+                "collector.active_workers": self.collector.queue.active_workers,
+                "collector.processed": self.collector.queue.processed,
+                "collector.errors": self.collector.queue.errors,
+                "collector.spans_stored": self.collector.spans_stored,
+                "collector.spans_dropped": self.collector.spans_dropped,
+                "sampler.rate": self.collector.sampler.rate,
+            })
+        counters = getattr(self.query.store, "counters", None)
+        if callable(counters):
+            out.update({f"store.{k}": v for k, v in counters().items()})
+        return out
+
+
+def _require(params, key):
+    v = params.get(key)
+    if not v:
+        raise QueryException(f"{key} is required")
+    return v
+
+
+def make_server(api: ApiServer, host: str = "0.0.0.0", port: int = 9411
+                ) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def _respond(self):
+            parsed = urlparse(self.path)
+            params = dict(parse_qsl(parsed.query))
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            status, payload = api.handle(
+                self.command, parsed.path, params, body
+            )
+            data = json.dumps(payload).encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        do_GET = _respond
+        do_POST = _respond
+
+        def log_message(self, *args):  # quiet by default
+            pass
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_forever_in_thread(server: ThreadingHTTPServer) -> threading.Thread:
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t

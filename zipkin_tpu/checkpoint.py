@@ -1,0 +1,148 @@
+"""Checkpoint/restore for the device store (durability).
+
+The reference's durability IS its storage backend (Cassandra TTLs,
+CassieSpanStore.scala:47-48); the TPU store's state lives in HBM, so
+durability is an explicit snapshot: device state pytree → host npz +
+dictionaries/TTL map → json. Restore rebuilds an equivalent
+TpuSpanStore (SURVEY.md §5 checkpoint/resume).
+
+Snapshots are atomic (write to a temp dir, rename) so a crash mid-save
+leaves the previous checkpoint intact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Optional
+
+import jax
+import numpy as np
+
+from zipkin_tpu.columnar.dictionary import DictionarySet
+from zipkin_tpu.columnar.encode import SpanCodec
+from zipkin_tpu.store import device as dev
+from zipkin_tpu.store.tpu import TpuSpanStore
+
+_STATE_FILE = "state.npz"
+_META_FILE = "meta.json"
+
+
+def _dict_dump(d) -> list:
+    out = []
+    for v in d.values():
+        if isinstance(v, bytes):
+            out.append({"b": v.hex()})
+        elif isinstance(v, tuple):
+            out.append({"t": list(v)})
+        elif v is None:
+            out.append({"n": None})
+        else:
+            out.append({"s": v})
+    return out
+
+
+def _dict_load(dictionary, values: list) -> None:
+    for item in values:
+        if "b" in item:
+            dictionary.encode(bytes.fromhex(item["b"]))
+        elif "t" in item:
+            dictionary.encode(tuple(item["t"]))
+        elif "n" in item:
+            dictionary.encode(None)
+        else:
+            dictionary.encode(item["s"])
+
+
+def save(store: TpuSpanStore, path: str) -> None:
+    """Snapshot a TpuSpanStore to ``path`` (a directory), atomically."""
+    leaves = {}
+    for name in dev.StoreState._FIELDS:
+        value = getattr(store.state, name)
+        if name == "counters":
+            for k, v in value.items():
+                leaves[f"counters.{k}"] = np.asarray(v)
+        else:
+            leaves[name] = np.asarray(value)
+    meta = {
+        "config": store.config._asdict(),
+        "ttls": {str(k): v for k, v in store.ttls.items()},
+        "name_lc": {str(k): v for k, v in store._name_lc.items()},
+        "dicts": {
+            "services": _dict_dump(store.dicts.services),
+            "span_names": _dict_dump(store.dicts.span_names),
+            "annotations": _dict_dump(store.dicts.annotations),
+            "binary_keys": _dict_dump(store.dicts.binary_keys),
+            "binary_values": _dict_dump(store.dicts.binary_values),
+            "endpoints": _dict_dump(store.dicts.endpoints),
+        },
+    }
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = tempfile.mkdtemp(prefix=".ckpt-", dir=parent)
+    old = path + ".old"
+    try:
+        np.savez_compressed(os.path.join(tmp, _STATE_FILE), **leaves)
+        with open(os.path.join(tmp, _META_FILE), "w") as f:
+            json.dump(meta, f)
+        # Keep the previous checkpoint alive until the new one is in
+        # place: path → path.old, tmp → path, then drop path.old. A crash
+        # at any point leaves either path or path.old restorable (load()
+        # falls back to path.old).
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.isdir(path):
+            os.replace(path, old)
+        os.replace(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load(path: str) -> TpuSpanStore:
+    """Restore a TpuSpanStore from a snapshot directory (falling back to
+    the ``.old`` snapshot if a save crashed mid-swap)."""
+    if not os.path.isdir(path) and os.path.isdir(path + ".old"):
+        path = path + ".old"
+    with open(os.path.join(path, _META_FILE)) as f:
+        meta = json.load(f)
+    config = dev.StoreConfig(**meta["config"])
+
+    dicts = DictionarySet.__new__(DictionarySet)
+    from zipkin_tpu.columnar.dictionary import Dictionary
+    from zipkin_tpu.models.constants import (
+        CORE_ANNOTATION_IDS,
+        FIRST_USER_ANNOTATION_ID,
+    )
+
+    dicts.services = Dictionary()
+    dicts.span_names = Dictionary()
+    dicts.annotations = Dictionary(reserved=dict(CORE_ANNOTATION_IDS))
+    dicts.binary_keys = Dictionary()
+    dicts.binary_values = Dictionary()
+    dicts.endpoints = Dictionary()
+    d = meta["dicts"]
+    # Annotation dict dump includes the reserved entries; replay in order.
+    for name in ("services", "span_names", "binary_keys",
+                 "binary_values", "endpoints"):
+        _dict_load(getattr(dicts, name), d[name])
+    ann = Dictionary()
+    _dict_load(ann, d["annotations"])
+    dicts.annotations = ann
+
+    store = TpuSpanStore(config, codec=SpanCodec(dicts))
+    store.ttls = {int(k): v for k, v in meta["ttls"].items()}
+    store._name_lc = {int(k): v for k, v in meta["name_lc"].items()}
+
+    data = np.load(os.path.join(path, _STATE_FILE))
+    upd = {}
+    counters = {}
+    for key in data.files:
+        if key.startswith("counters."):
+            counters[key.split(".", 1)[1]] = jax.numpy.asarray(data[key])
+        else:
+            upd[key] = jax.numpy.asarray(data[key])
+    upd["counters"] = counters
+    store.state = store.state.replace(**upd)
+    return store

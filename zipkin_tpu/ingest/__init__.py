@@ -1,0 +1,17 @@
+"""Ingest runtime: bounded queue, receivers, and the collector assembly.
+
+Reference parity: zipkin-collector's ItemQueue pipeline
+(ItemQueue.scala:39, SpanReceiver.scala:27, ZipkinCollectorFactory.scala:40-76)
+and the scribe/kafka receivers — the host-side runtime that feeds the
+device. Backpressure semantics carry over exactly: a full queue raises
+QueueFullException, which receivers surface as TRY_LATER so upstream
+transports buffer and retry.
+"""
+
+from zipkin_tpu.ingest.queue import ItemQueue, QueueFullException  # noqa: F401
+from zipkin_tpu.ingest.receiver import (  # noqa: F401
+    JsonReceiver,
+    ResultCode,
+    ScribeReceiver,
+)
+from zipkin_tpu.ingest.collector import Collector  # noqa: F401

@@ -1,0 +1,93 @@
+"""Collector: receiver → bounded queue → sampler filter → store(s).
+
+Reference wiring (ZipkinCollectorFactory.scala:40-76): the receiver
+pushes span batches into the ItemQueue; worker threads run the filter
+chain (sampling: keep iff debug or the rate test passes,
+SpanSamplerFilter.scala:40-47) and hand survivors to the WriteSpanStore.
+The adaptive controller reads the flow from the store counters and
+moves the sampler's rate (AdaptiveSampler wiring, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from zipkin_tpu.ingest.queue import ItemQueue
+from zipkin_tpu.models.span import Span
+from zipkin_tpu.sampler.adaptive import (
+    AdaptiveConfig,
+    AdaptiveSampleRateController,
+    FlowEstimator,
+)
+from zipkin_tpu.sampler.core import Sampler
+from zipkin_tpu.store.base import WriteSpanStore
+
+
+class Collector:
+    def __init__(
+        self,
+        store: WriteSpanStore,
+        sampler: Optional[Sampler] = None,
+        adaptive: Optional[AdaptiveConfig] = None,
+        max_queue: int = 500,
+        concurrency: int = 10,
+    ):
+        self.store = store
+        self.sampler = sampler or Sampler(1.0)
+        self.queue: ItemQueue = ItemQueue(
+            self._write, max_size=max_queue, concurrency=concurrency
+        )
+        self.controller = (
+            AdaptiveSampleRateController(adaptive) if adaptive else None
+        )
+        self._flow = FlowEstimator()
+        self._last_tick_s: Optional[float] = None
+        self.spans_dropped = 0
+        self.spans_stored = 0
+
+    # -- pipeline -------------------------------------------------------
+
+    def accept(self, spans: Sequence[Span]) -> None:
+        """Receiver-facing entry; raises QueueFullException when full."""
+        self.queue.add(list(spans))
+
+    def _write(self, spans) -> None:
+        kept = [s for s in spans if s.debug or self.sampler(s.trace_id)]
+        self.spans_dropped += len(spans) - len(kept)
+        if kept:
+            self.store.apply(kept)
+            self.spans_stored += len(kept)
+
+    # -- control loop (call periodically, e.g. every 30s) ---------------
+
+    def control_tick(self, now_s: Optional[float] = None) -> Optional[float]:
+        """Feed the store rate into the adaptive controller; returns the
+        new sample rate when it moves. Single-controller: this replaces
+        the ZK group + leader election (AdaptiveSampler.scala:177-237).
+
+        Safe to call at any cadence — observations are gated to the
+        controller's update_freq_s so a tight daemon loop doesn't shrink
+        the adaptive windows.
+        """
+        if self.controller is None:
+            return None
+        now_s = time.time() if now_s is None else now_s
+        freq = self.controller.config.update_freq_s
+        if self._last_tick_s is not None and now_s - self._last_tick_s < freq:
+            return None
+        self._last_tick_s = now_s
+        rate = self._flow.observe(float(self.spans_stored), now_s)
+        if rate is None:
+            return None
+        new_rate = self.controller.observe(rate, now_s)
+        if new_rate is not None:
+            self.sampler.rate = new_rate
+        return new_rate
+
+    def flush(self) -> None:
+        self.queue.join()
+
+    def close(self) -> None:
+        self.queue.close()
+        self.store.close()

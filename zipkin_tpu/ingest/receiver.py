@@ -1,0 +1,185 @@
+"""Span receivers: transport payloads → spans → the collector pipeline.
+
+Reference: SpanReceiver (zipkin-collector/.../SpanReceiver.scala:27) and
+the scribe receiver's decode/whitelist/pushback behavior
+(ScribeSpanReceiver.scala:78-141). The kafka receiver's consumer loop is
+a transport concern; its decode path is identical to scribe's minus the
+base64 (KafkaProcessor.scala:25) and is covered by ``decode_thrift``.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+from zipkin_tpu.ingest.queue import QueueFullException
+from zipkin_tpu.models.span import (
+    Annotation,
+    AnnotationType,
+    BinaryAnnotation,
+    Endpoint,
+    Span,
+)
+from zipkin_tpu.wire.thrift import (
+    ThriftError,
+    scribe_message_to_span,
+    spans_from_bytes,
+)
+
+
+class ResultCode(enum.Enum):
+    """Scribe result codes (scribe.thrift): TRY_LATER = backpressure."""
+
+    OK = 0
+    TRY_LATER = 1
+
+
+class ScribeReceiver:
+    """Scribe Log() endpoint: base64-thrift LogEntries → spans → process.
+
+    ``process`` is typically Collector.accept (→ ItemQueue.add); a
+    QueueFullException surfaces as TRY_LATER so scribe clients buffer
+    and retry (ScribeSpanReceiver.scala:133-141).
+    """
+
+    def __init__(
+        self,
+        process: Callable[[Sequence[Span]], None],
+        categories: Iterable[str] = ("zipkin",),
+    ):
+        self.process = process
+        self.categories = {c.lower() for c in categories}
+        self.stats: Dict[str, int] = {
+            "received": 0, "ignored": 0, "bad": 0, "pushed_back": 0,
+        }
+
+    def log(self, entries: Sequence[tuple]) -> ResultCode:
+        """entries: (category, message) pairs — the Scribe.Log call."""
+        spans: List[Span] = []
+        for category, message in entries:
+            self.stats["received"] += 1
+            if category.lower() not in self.categories:
+                self.stats["ignored"] += 1
+                continue
+            try:
+                spans.append(scribe_message_to_span(message))
+            except ThriftError:
+                self.stats["bad"] += 1
+        if not spans:
+            return ResultCode.OK
+        try:
+            self.process(spans)
+        except QueueFullException:
+            self.stats["pushed_back"] += 1
+            return ResultCode.TRY_LATER
+        return ResultCode.OK
+
+
+def decode_thrift(payload: bytes) -> List[Span]:
+    """Raw thrift span sequence → spans (the kafka message decode path)."""
+    return spans_from_bytes(payload)
+
+
+class JsonReceiver:
+    """JSON span receiver for HTTP-posted spans (the tracegen/web feed).
+
+    Accepts a list of span dicts in the shape the web API emits; not a
+    reference transport, but the natural REST ingest door for a modern
+    deployment.
+    """
+
+    def __init__(self, process: Callable[[Sequence[Span]], None]):
+        self.process = process
+
+    def post(self, body: bytes) -> ResultCode:
+        spans = [span_from_json(d) for d in json.loads(body)]
+        try:
+            self.process(spans)
+        except QueueFullException:
+            return ResultCode.TRY_LATER
+        return ResultCode.OK
+
+
+def _endpoint_from_json(d: Optional[dict]) -> Optional[Endpoint]:
+    if not d:
+        return None
+    return Endpoint(
+        ipv4=int(d.get("ipv4", 0)),
+        port=int(d.get("port", 0)),
+        service_name=d.get("serviceName", "unknown"),
+    )
+
+
+def span_from_json(d: dict) -> Span:
+    anns = tuple(
+        Annotation(
+            timestamp=int(a["timestamp"]),
+            value=a["value"],
+            host=_endpoint_from_json(a.get("endpoint")),
+        )
+        for a in d.get("annotations", ())
+    )
+    banns = []
+    for b in d.get("binaryAnnotations", ()):
+        t = AnnotationType[b.get("type", "STRING")]
+        value = b.get("value", "")
+        if t == AnnotationType.BYTES and isinstance(value, str):
+            import base64
+
+            value = base64.b64decode(value)
+        banns.append(
+            BinaryAnnotation(
+                key=b["key"], value=value, annotation_type=t,
+                host=_endpoint_from_json(b.get("endpoint")),
+            )
+        )
+    return Span(
+        trace_id=int(d["traceId"], 16) if isinstance(d["traceId"], str)
+        else int(d["traceId"]),
+        name=d.get("name", ""),
+        id=int(d["id"], 16) if isinstance(d["id"], str) else int(d["id"]),
+        parent_id=(
+            None if d.get("parentId") in (None, "")
+            else int(d["parentId"], 16) if isinstance(d["parentId"], str)
+            else int(d["parentId"])
+        ),
+        annotations=anns,
+        binary_annotations=tuple(banns),
+        debug=bool(d.get("debug", False)),
+    )
+
+
+def span_to_json(s: Span) -> dict:
+    def ep(e: Optional[Endpoint]):
+        if e is None:
+            return None
+        return {"ipv4": e.ipv4, "port": e.port, "serviceName": e.service_name}
+
+    banns = []
+    for b in s.binary_annotations:
+        value = b.value
+        if isinstance(value, (bytes, bytearray)):
+            if b.annotation_type == AnnotationType.BYTES:
+                import base64
+
+                value = base64.b64encode(bytes(value)).decode("ascii")
+            else:
+                value = bytes(value).decode("utf-8", "replace")
+        banns.append({
+            "key": b.key, "value": value,
+            "type": b.annotation_type.name, "endpoint": ep(b.host),
+        })
+    return {
+        "traceId": s.trace_id,
+        "name": s.name,
+        "id": s.id,
+        "parentId": s.parent_id,
+        "annotations": [
+            {"timestamp": a.timestamp, "value": a.value,
+             "endpoint": ep(a.host)}
+            for a in s.annotations
+        ],
+        "binaryAnnotations": banns,
+        "debug": s.debug,
+    }

@@ -1,0 +1,12 @@
+"""Composed entry points (the zipkin-example / zipkin-deployment-* mains).
+
+- ``zipkin_tpu.main.example``: everything in one process — collector +
+  TPU store + query + HTTP API + optional tracegen seed
+  (zipkin-example/.../Main.scala).
+- ``zipkin_tpu.main.tracegen``: generate traces, push them through the
+  collector, then read them back through every query API
+  (zipkin-tracegen/.../Main.scala:40-117).
+
+Flags are argparse (the TwitterServer-flags analogue); every flag has
+the reference's default where one exists.
+"""

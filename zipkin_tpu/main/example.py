@@ -1,0 +1,125 @@
+"""All-in-one daemon: collector + device store + query + HTTP API.
+
+Usage:
+    python -m zipkin_tpu.main.example --port 9411 [--seed-traces 10]
+        [--sample-rate 1.0] [--adaptive-target N] [--checkpoint DIR]
+        [--memory-store]
+
+Reference shape: zipkin-example's Main (scribe receiver + store + query
++ web in one process) and zipkin-deployment-collector's sampler wiring.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9411)
+    p.add_argument("--memory-store", action="store_true",
+                   help="use the in-memory reference store instead of TPU")
+    p.add_argument("--capacity", type=int, default=1 << 16,
+                   help="span ring capacity (device store)")
+    p.add_argument("--sample-rate", type=float, default=1.0)
+    p.add_argument("--adaptive-target", type=float, default=0.0,
+                   help="target stored spans/minute; 0 disables adaptive")
+    p.add_argument("--queue-max", type=int, default=500)
+    p.add_argument("--queue-workers", type=int, default=10)
+    p.add_argument("--seed-traces", type=int, default=0,
+                   help="generate N synthetic traces at startup")
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint dir: restore at boot, save on exit "
+                        "and every --checkpoint-interval seconds")
+    p.add_argument("--checkpoint-interval", type=float, default=300.0)
+    return p
+
+
+def build_app(args):
+    from zipkin_tpu.api.server import ApiServer
+    from zipkin_tpu.ingest.collector import Collector
+    from zipkin_tpu.query.service import QueryService
+    from zipkin_tpu.sampler.adaptive import AdaptiveConfig
+    from zipkin_tpu.sampler.core import Sampler
+
+    store = None
+    if args.checkpoint and not args.memory_store:
+        import os
+
+        from zipkin_tpu import checkpoint
+
+        if os.path.isdir(args.checkpoint):
+            store = checkpoint.load(args.checkpoint)
+    if store is None:
+        if args.memory_store:
+            from zipkin_tpu.store.memory import InMemorySpanStore
+
+            store = InMemorySpanStore()
+        else:
+            from zipkin_tpu.store.device import StoreConfig
+            from zipkin_tpu.store.tpu import TpuSpanStore
+
+            store = TpuSpanStore(StoreConfig(capacity=args.capacity))
+    adaptive = (
+        AdaptiveConfig(target_store_rate=args.adaptive_target)
+        if args.adaptive_target > 0 else None
+    )
+    collector = Collector(
+        store, sampler=Sampler(args.sample_rate), adaptive=adaptive,
+        max_queue=args.queue_max, concurrency=args.queue_workers,
+    )
+    api = ApiServer(QueryService(store), collector)
+    return store, collector, api
+
+
+def seed(collector, n_traces: int) -> None:
+    from zipkin_tpu.tracegen import generate_traces
+
+    for spans in generate_traces(n_traces=n_traces):
+        collector.accept(spans)
+    collector.flush()
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    store, collector, api = build_app(args)
+    if args.seed_traces:
+        seed(collector, args.seed_traces)
+
+    from zipkin_tpu.api.server import make_server, serve_forever_in_thread
+
+    server = make_server(api, args.host, args.port)
+    serve_forever_in_thread(server)
+    print(f"zipkin-tpu example serving on {args.host}:{args.port}")
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    def checkpoint_now():
+        if args.checkpoint and not args.memory_store:
+            from zipkin_tpu import checkpoint
+
+            checkpoint.save(store, args.checkpoint)
+
+    last_ckpt = time.time()
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+            collector.control_tick()
+            if (args.checkpoint
+                    and time.time() - last_ckpt > args.checkpoint_interval):
+                checkpoint_now()
+                last_ckpt = time.time()
+    finally:
+        checkpoint_now()
+        server.shutdown()
+        collector.close()
+
+
+if __name__ == "__main__":
+    main()

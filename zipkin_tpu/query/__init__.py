@@ -1,0 +1,18 @@
+"""Query layer: the ZipkinQuery service semantics over any SpanStore.
+
+Reference parity: zipkin-query (ThriftQueryService.scala:32) — slice
+queries with aligned-timestamp intersection, timestamp/duration
+ordering, trace assembly with pluggable adjusters (TimeSkewAdjuster),
+and summary/timeline/combo projections — re-hosted as a plain python
+service over the SpanStore SPI (the RPC surface lives in zipkin_tpu.api).
+"""
+
+from zipkin_tpu.query.request import (  # noqa: F401
+    BinaryAnnotationQuery,
+    Order,
+    QueryException,
+    QueryRequest,
+    QueryResponse,
+)
+from zipkin_tpu.query.adjusters import TimeSkewAdjuster  # noqa: F401
+from zipkin_tpu.query.service import QueryService  # noqa: F401

@@ -1,0 +1,229 @@
+"""QueryService: getTraceIds slice/intersect/order semantics + trace reads.
+
+Reference: ThriftQueryService.scala:32-197 and the older
+QueryService.scala:39-511, re-expressed over the SpanStore SPI. The RPC
+framing (thrift) is replaced by plain python + the JSON HTTP layer in
+zipkin_tpu.api; the semantics — slice queries, probe-then-align
+intersection with the one-minute pad, order-by with batched duration
+fetches — carry over.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from zipkin_tpu.models.span import Span
+from zipkin_tpu.models.trace import Trace, TraceCombo, TraceSummary, TraceTimeline
+from zipkin_tpu.query.adjusters import TimeSkewAdjuster
+from zipkin_tpu.query.request import (
+    Order,
+    QueryException,
+    QueryRequest,
+    QueryResponse,
+)
+from zipkin_tpu.store.base import IndexedTraceId, SpanStore
+
+# Reference constants (zipkin-query/.../Constants.scala:26,
+# ThriftQueryService.scala:33).
+TRACE_TIMESTAMP_PADDING_US = 60 * 1_000_000
+DURATION_FETCH_BATCH = 500
+
+
+class QueryService:
+    def __init__(
+        self,
+        store: SpanStore,
+        adjust_clock_skew: bool = True,
+        duration_batch: int = DURATION_FETCH_BATCH,
+    ):
+        self.store = store
+        self.adjust_clock_skew = adjust_clock_skew
+        self.duration_batch = duration_batch
+
+    # -- getTraceIds ----------------------------------------------------
+
+    def get_trace_ids(self, qr: QueryRequest) -> QueryResponse:
+        if not qr.service_name:
+            raise QueryException("No service name provided")
+        slices = self._slice_queries(qr)
+        if not slices:
+            ids = self.store.get_trace_ids_by_name(
+                qr.service_name, None, qr.end_ts, qr.limit
+            )
+            return self._response(ids, qr)
+        if len(slices) == 1:
+            return self._response(self._query_slices(slices, qr), qr)
+        # Multi-slice: probe each slice at limit 1 to find the latest
+        # timestamp they can all reach, pad by one minute, re-query all
+        # slices aligned there, then intersect.
+        probes = self._query_slices(slices, qr, limit=1)
+        probe_ts = [i.timestamp for i in probes]
+        aligned = (min(probe_ts) if probe_ts else 0) + TRACE_TIMESTAMP_PADDING_US
+        per_slice = [
+            self._query_one(s, qr, end_ts=aligned, limit=qr.limit)
+            for s in slices
+        ]
+        common = _intersect(per_slice)
+        if not common:
+            # Nothing common: report the best next endTs for pagination.
+            mins = [
+                min((i.timestamp for i in ids), default=0) for ids in per_slice
+            ]
+            return self._response([], qr, end_ts=max(mins, default=0))
+        return self._response(common, qr)
+
+    def _slice_queries(self, qr: QueryRequest) -> List[tuple]:
+        slices: List[tuple] = []
+        if qr.span_name:
+            slices.append(("span", qr.span_name, None))
+        for a in qr.annotations:
+            slices.append(("annotation", a, None))
+        for b in qr.binary_annotations:
+            slices.append(("annotation", b.key, b.value))
+        return slices
+
+    def _query_one(self, s, qr: QueryRequest, end_ts: int, limit: int
+                   ) -> List[IndexedTraceId]:
+        kind, key, value = s
+        if kind == "span":
+            return self.store.get_trace_ids_by_name(
+                qr.service_name, key, end_ts, limit
+            )
+        return self.store.get_trace_ids_by_annotation(
+            qr.service_name, key, value, end_ts, limit
+        )
+
+    def _query_slices(self, slices, qr: QueryRequest, limit: Optional[int] = None
+                      ) -> List[IndexedTraceId]:
+        out: List[IndexedTraceId] = []
+        for s in slices:
+            out.extend(self._query_one(s, qr, qr.end_ts, limit or qr.limit))
+        return out
+
+    def _response(self, ids: Sequence[IndexedTraceId], qr: QueryRequest,
+                  end_ts: int = -1) -> QueryResponse:
+        sorted_ids = self._sorted_trace_ids(ids, qr.limit, qr.order)
+        if not sorted_ids:
+            return QueryResponse((), -1, end_ts)
+        ts = [i.timestamp for i in ids]
+        return QueryResponse(tuple(sorted_ids), min(ts), max(ts))
+
+    def _sorted_trace_ids(self, ids: Sequence[IndexedTraceId], limit: int,
+                          order: Order) -> List[int]:
+        if order is Order.NONE:
+            return [i.trace_id for i in ids][:limit]
+        if order in (Order.TIMESTAMP_DESC, Order.TIMESTAMP_ASC):
+            rev = order is Order.TIMESTAMP_DESC
+            return [
+                i.trace_id
+                for i in sorted(ids, key=lambda x: x.timestamp, reverse=rev)
+            ][:limit]
+        # Duration orders: fetch durations in batches of 500
+        # (ThriftQueryService.scala:33, QueryService.scala:493-511).
+        tids = [i.trace_id for i in ids]
+        durations = []
+        for i in range(0, len(tids), self.duration_batch):
+            durations.extend(
+                self.store.get_traces_duration(tids[i:i + self.duration_batch])
+            )
+        rev = order is Order.DURATION_DESC
+        return [
+            d.trace_id
+            for d in sorted(durations, key=lambda x: x.duration, reverse=rev)
+        ][:limit]
+
+    # -- trace reads ----------------------------------------------------
+
+    def get_traces_by_ids(self, trace_ids: Sequence[int],
+                          adjust: Optional[bool] = None) -> List[Trace]:
+        adjust = self.adjust_clock_skew if adjust is None else adjust
+        found = self.store.get_spans_by_trace_ids(trace_ids)
+        traces = [Trace(spans) for spans in found]
+        if adjust:
+            adjuster = TimeSkewAdjuster()
+            traces = [adjuster.adjust(t) for t in traces]
+        return traces
+
+    def get_trace_summaries_by_ids(self, trace_ids, adjust=None
+                                   ) -> List[TraceSummary]:
+        out = []
+        for t in self.get_traces_by_ids(trace_ids, adjust):
+            s = TraceSummary.from_trace(t)
+            if s is not None:
+                out.append(s)
+        return out
+
+    def get_trace_timelines_by_ids(self, trace_ids, adjust=None
+                                   ) -> List[TraceTimeline]:
+        out = []
+        for t in self.get_traces_by_ids(trace_ids, adjust):
+            tl = TraceTimeline.from_trace(t)
+            if tl is not None:
+                out.append(tl)
+        return out
+
+    def get_trace_combos_by_ids(self, trace_ids, adjust=None
+                                ) -> List[TraceCombo]:
+        return [
+            TraceCombo.from_trace(t)
+            for t in self.get_traces_by_ids(trace_ids, adjust)
+        ]
+
+    def trace_exists(self, trace_id: int) -> bool:
+        return bool(self.store.traces_exist([trace_id]))
+
+    # -- catalogs / aggregates -----------------------------------------
+
+    def get_service_names(self):
+        return self.store.get_all_service_names()
+
+    def get_span_names(self, service: str):
+        return self.store.get_span_names(service)
+
+    def get_dependencies(self, start_ts: Optional[int] = None,
+                         end_ts: Optional[int] = None):
+        """Dependencies from the store's aggregate state (Aggregates.scala:31).
+
+        Stores without dependency aggregation (the in-memory reference
+        store) behave like NullAggregates and return zero."""
+        from zipkin_tpu.models.dependencies import Dependencies
+
+        getter = getattr(self.store, "get_dependencies", None)
+        if getter is None:
+            return Dependencies.zero()
+        return getter()
+
+    def get_top_annotations(self, service: str, k: int = 10) -> List[str]:
+        getter = getattr(self.store, "top_annotations", None)
+        return [a for a, _ in getter(service, k)] if getter else []
+
+    def get_top_key_value_annotations(self, service: str, k: int = 10
+                                      ) -> List[str]:
+        getter = getattr(self.store, "top_binary_keys", None)
+        return [a for a, _ in getter(service, k)] if getter else []
+
+    def set_trace_time_to_live(self, trace_id: int, ttl_s: float) -> None:
+        self.store.set_time_to_live(trace_id, ttl_s)
+
+    def get_trace_time_to_live(self, trace_id: int) -> float:
+        return self.store.get_time_to_live(trace_id)
+
+
+def _intersect(per_slice: List[List[IndexedTraceId]]) -> List[IndexedTraceId]:
+    """Ids present in every slice, stamped with their max timestamp
+    (traceIdsIntersect, ThriftQueryService.scala:92)."""
+    if not per_slice:
+        return []
+    maps: List[Dict[int, List[int]]] = []
+    for ids in per_slice:
+        m: Dict[int, List[int]] = {}
+        for i in ids:
+            m.setdefault(i.trace_id, []).append(i.timestamp)
+        maps.append(m)
+    common = set(maps[0])
+    for m in maps[1:]:
+        common &= set(m)
+    return [
+        IndexedTraceId(tid, max(ts for m in maps for ts in m[tid]))
+        for tid in common
+    ]

@@ -322,6 +322,52 @@ def make_device_batch(
 
 
 # ---------------------------------------------------------------------------
+# Dependency-link kernel (shared by ingest_step and offline recompute)
+# ---------------------------------------------------------------------------
+
+
+def dep_link_moments(
+    trace_id, span_id, parent_id, service_id, duration,
+    build_valid, probe_valid, n_services: int,
+):
+    """[S*S, 5] Moments of child durations per (parent_svc, child_svc).
+
+    The device-native ZipkinAggregateJob.scala:26-38: a sort-merge join
+    of (trace_id, parent_id) against (trace_id, span_id) followed by a
+    segmented moments reduction — no shuffles, one launch.
+    """
+    S = n_services
+    found, parent_svc = join.lookup(
+        (trace_id, span_id), build_valid, service_id,
+        (trace_id, parent_id), probe_valid,
+    )
+    link_ok = (
+        found
+        & (parent_svc >= 0) & (service_id >= 0)
+        & (parent_svc < S) & (service_id < S)
+        & (duration >= 0)
+    )
+    link_id = jnp.where(link_ok, parent_svc.astype(jnp.int32) * S + service_id, 0)
+    return M.segment_moments(
+        duration.astype(jnp.float32), link_id, S * S, valid=link_ok
+    )
+
+
+@jax.jit
+def recompute_dep_moments(state: "StoreState"):
+    """Offline recompute over the live span ring (the rerunnable-batch-job
+    analogue; parity check for the streaming bank)."""
+    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
+
+    live = state.row_gid >= 0
+    has_parent = (state.flags & jnp.int32(int(FLAG_HAS_PARENT))) != 0
+    return dep_link_moments(
+        state.trace_id, state.span_id, state.parent_id, state.service_id,
+        state.duration, live, live & has_parent, state.config.max_services,
+    )
+
+
+# ---------------------------------------------------------------------------
 # ingest_step — ONE fused launch per batch
 # ---------------------------------------------------------------------------
 
@@ -381,25 +427,9 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     # -- dependency links: within-batch parent join --------------------
     # (trace_id, parent_id) probe against (trace_id, span_id) build —
     # the streaming form of ZipkinAggregateJob.scala:26-38.
-    probe_valid = mask & b.has_parent
-    found, parent_svc = join.lookup(
-        (b.trace_id, b.span_id), mask, b.service_id,
-        (b.trace_id, b.parent_id), probe_valid,
-    )
-    child_svc = b.service_id
-    link_ok = (
-        found
-        & (parent_svc >= 0)
-        & (child_svc >= 0)
-        & (parent_svc < S)
-        & (child_svc < S)
-        & (b.duration >= 0)
-    )
-    link_id = jnp.where(
-        link_ok, parent_svc.astype(jnp.int32) * S + child_svc, 0
-    )
-    batch_moments = M.segment_moments(
-        b.duration.astype(jnp.float32), link_id, S * S, valid=link_ok
+    batch_moments = dep_link_moments(
+        b.trace_id, b.span_id, b.parent_id, b.service_id, b.duration,
+        mask, mask & b.has_parent, S,
     )
     upd["dep_moments"] = M.combine(state.dep_moments, batch_moments)
 

@@ -84,6 +84,9 @@ class TpuSpanStore(SpanStore):
     # ItemQueue-aligned chunk bound: keeps jit shapes bounded and batches
     # well under any ring capacity.
     MAX_CHUNK = 4096
+    # Bound on the host TTL map (pins + recent traces); ring eviction has
+    # no host-side hook, so pruning happens on insert.
+    MAX_TTL_ENTRIES = 1 << 20
 
     def apply(self, spans: Sequence[Span]) -> None:
         if not spans:
@@ -91,14 +94,48 @@ class TpuSpanStore(SpanStore):
         with self._lock:
             for span in spans:
                 self.ttls[span.trace_id] = 1.0
-            chunk = min(self.MAX_CHUNK, self.config.capacity // 2 or 1)
-            for i in range(0, len(spans), chunk):
-                part = list(spans[i:i + chunk])
+            self._prune_ttls()
+            # Chunk on whole-trace boundaries: the streaming dependency
+            # join is within-batch, so splitting a trace across chunks
+            # would silently drop its parent→child links.
+            for part in self._chunk_by_trace(spans):
                 batch = self.codec.encode(part)
                 indexable = np.fromiter(
                     (should_index(s) for s in part), bool, len(part)
                 )
                 self.write_batch(batch, indexable)
+
+    def _chunk_by_trace(self, spans: Sequence[Span]):
+        chunk_size = min(self.MAX_CHUNK, self.config.capacity // 2 or 1)
+        by_trace: Dict[int, List[Span]] = {}
+        for s in spans:
+            by_trace.setdefault(s.trace_id, []).append(s)
+        batch: List[Span] = []
+        for trace_spans in by_trace.values():
+            if batch and len(batch) + len(trace_spans) > chunk_size:
+                yield batch
+                batch = []
+            batch.extend(trace_spans)
+            # A single trace larger than the chunk is split (its
+            # cross-chunk links fall to the offline recompute path).
+            while len(batch) > chunk_size:
+                yield batch[:chunk_size]
+                batch = batch[chunk_size:]
+        if batch:
+            yield batch
+
+    def _prune_ttls(self) -> None:
+        """Drop oldest non-pinned TTL entries beyond the bound (ring
+        eviction is the real retention; pins survive)."""
+        excess = len(self.ttls) - self.MAX_TTL_ENTRIES
+        if excess <= 0:
+            return
+        for tid in list(self.ttls):
+            if excess <= 0:
+                break
+            if self.ttls[tid] <= 1.0:
+                del self.ttls[tid]
+                excess -= 1
 
     def write_batch(self, batch: SpanBatch, indexable: np.ndarray) -> None:
         """Upload one columnar batch and run the fused ingest step.
@@ -355,26 +392,13 @@ class TpuSpanStore(SpanStore):
     def get_dependencies(self) -> Dependencies:
         """DependencyLinks from the streaming Moments bank — the live
         equivalent of Aggregates.getDependencies (Aggregates.scala:31)."""
-        S = self.config.max_services
-        bank = np.asarray(self.state.dep_moments, np.float64)
-        nz = np.flatnonzero(bank[:, 0] > 0)
-        d = self.dicts.services
-        links = []
-        for li in nz:
-            parent, child = divmod(int(li), S)
-            if parent >= len(d) or child >= len(d):
-                continue
-            links.append(
-                DependencyLink(
-                    d.decode(parent), d.decode(child),
-                    Moments.from_central(*bank[li]),
-                )
-            )
-        ts_min = int(self.state.ts_min)
-        ts_max = int(self.state.ts_max)
-        if not links and ts_min > ts_max:
-            return Dependencies.zero()
-        return Dependencies(float(ts_min), float(ts_max), tuple(links))
+        from zipkin_tpu.aggregate.job import dependencies_from_bank
+
+        return dependencies_from_bank(
+            self.state.dep_moments, self.dicts.services,
+            self.config.max_services,
+            float(self.state.ts_min), float(self.state.ts_max),
+        )
 
     def service_duration_quantiles(
         self, service: str, qs: Sequence[float]
