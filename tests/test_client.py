@@ -1,0 +1,103 @@
+"""Client instrumentation tests: B3 headers, tracer, WSGI middleware,
+and the end-to-end instrumented-app → collector → query loop."""
+
+import random
+
+import pytest
+
+from zipkin_tpu.client import (
+    B3Headers,
+    Tracer,
+    ZipkinWSGIMiddleware,
+)
+from zipkin_tpu.ingest.collector import Collector
+from zipkin_tpu.store.memory import InMemorySpanStore
+
+
+class TestB3Headers:
+    def test_parse_and_emit_roundtrip(self):
+        b3 = B3Headers(trace_id=0xABC, span_id=0x123, parent_id=0x99,
+                       sampled=True)
+        parsed = B3Headers.parse(b3.emit())
+        assert parsed == b3
+
+    def test_parse_missing(self):
+        assert B3Headers.parse({}) == B3Headers()
+
+    def test_parse_garbage_ignored(self):
+        parsed = B3Headers.parse({"X-B3-TraceId": "zz-not-hex"})
+        assert parsed.trace_id is None
+
+    def test_sampled_flag_forms(self):
+        assert B3Headers.parse({"X-B3-Sampled": "1"}).sampled is True
+        assert B3Headers.parse({"X-B3-Sampled": "0"}).sampled is False
+
+    def test_negative_ids_roundtrip_as_unsigned_hex(self):
+        b3 = B3Headers(trace_id=-5, span_id=-6)
+        parsed = B3Headers.parse(b3.emit())
+        assert parsed.trace_id == (-5) & (2**64 - 1)
+
+
+class TestTracer:
+    def test_server_span_continues_trace(self):
+        got = []
+        t = Tracer("api", got.extend, rng=random.Random(1))
+        span = t.server_span("get /x", B3Headers(trace_id=7, span_id=8,
+                                                 parent_id=6, sampled=True),
+                             start_us=100, end_us=200)
+        assert span is not None
+        assert span.trace_id == 7 and span.id == 8 and span.parent_id == 6
+        values = [a.value for a in span.annotations]
+        assert values == ["sr", "ss"]
+        assert got == [span]
+
+    def test_starts_new_trace_without_headers(self):
+        got = []
+        t = Tracer("api", got.extend, rng=random.Random(2))
+        span = t.server_span("x", B3Headers())
+        assert span.trace_id > 0 and span.id > 0 and span.parent_id is None
+
+    def test_upstream_not_sampled_wins(self):
+        got = []
+        t = Tracer("api", got.extend, sample_rate=1.0)
+        assert t.server_span("x", B3Headers(sampled=False)) is None
+        assert got == []
+
+    def test_sample_rate_zero(self):
+        t = Tracer("api", lambda s: None, sample_rate=0.0,
+                   rng=random.Random(3))
+        assert t.server_span("x", B3Headers()) is None
+
+
+class TestWSGIMiddleware:
+    def make_app(self):
+        def app(environ, start_response):
+            start_response("200 OK", [("Content-Type", "text/plain")])
+            return [b"hello"]
+
+        return app
+
+    def test_instrumented_request_lands_in_store(self):
+        store = InMemorySpanStore()
+        collector = Collector(store)
+        tracer = Tracer("front", collector.accept, rng=random.Random(4))
+        app = ZipkinWSGIMiddleware(self.make_app(), tracer)
+        environ = {
+            "PATH_INFO": "/hello",
+            "REQUEST_METHOD": "GET",
+            "HTTP_X_B3_TRACEID": "ff",
+            "HTTP_X_B3_SPANID": "ee",
+            "HTTP_X_B3_SAMPLED": "1",
+        }
+        body = app(environ, lambda *a, **k: None)
+        assert body == [b"hello"]
+        collector.flush()
+        spans = store.get_spans_by_trace_id(0xFF)
+        assert len(spans) == 1
+        s = spans[0]
+        assert s.id == 0xEE and s.name == "get /hello"
+        tags = {b.key: b.value for b in s.binary_annotations}
+        assert tags["http.status"] == "200"
+        assert tags["http.uri"] == "/hello"
+        assert s.service_name == "front"
+        collector.close()

@@ -1,0 +1,124 @@
+"""Native C++ span parser: parity with the pure-python codec paths.
+
+Skipped wholesale when g++ is unavailable (the python paths remain the
+functional fallback)."""
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.columnar.dictionary import DictionarySet
+from zipkin_tpu.columnar.encode import SpanCodec
+from zipkin_tpu.models.span import (
+    Annotation,
+    AnnotationType,
+    BinaryAnnotation,
+    Endpoint,
+    Span,
+)
+from zipkin_tpu.wire.thrift import span_to_bytes
+
+native = pytest.importorskip("zipkin_tpu.native")
+if not native.available():
+    pytest.skip("g++ unavailable; native codec not built",
+                allow_module_level=True)
+
+WEB = Endpoint(0x01010101, 80, "Web")
+API = Endpoint(0x02020202, 443, "api")
+
+
+def spans_fixture():
+    return [
+        Span(
+            trace_id=-5, name="GET /x", id=7, parent_id=None,
+            annotations=(
+                Annotation(100, "cs", WEB),
+                Annotation(110, "sr", API),
+                Annotation(150, "custom-anno", API),
+                Annotation(190, "ss", API),
+                Annotation(200, "cr", WEB),
+            ),
+            binary_annotations=(
+                BinaryAnnotation("http.uri", "/x", AnnotationType.STRING, API),
+                BinaryAnnotation("raw", b"\x01\x02", AnnotationType.BYTES, None),
+                BinaryAnnotation("n", 17, AnnotationType.I32, None),
+            ),
+            debug=True,
+        ),
+        Span(trace_id=2**63 - 1, name="", id=-1, parent_id=7,
+             annotations=(Annotation(50, "sr", API),)),
+        Span(trace_id=3, name="bare", id=4),
+    ]
+
+
+def payload_of(spans):
+    return b"".join(span_to_bytes(s) for s in spans)
+
+
+class TestNativeParser:
+    def test_columns_match_python_codec(self):
+        spans = spans_fixture()
+        dicts = DictionarySet()
+        py = SpanCodec(dicts).encode(spans)
+        nat, name_lc = native.parse_spans_columnar(payload_of(spans), dicts)
+        for col in py.SPAN_COLUMNS + py.ANN_COLUMNS + py.BANN_COLUMNS:
+            np.testing.assert_array_equal(
+                getattr(nat, col), getattr(py, col), err_msg=col
+            )
+
+    def test_decodes_back_to_spans(self):
+        spans = spans_fixture()
+        dicts = DictionarySet()
+        codec = SpanCodec(dicts)
+        nat, _ = native.parse_spans_columnar(payload_of(spans), dicts)
+        assert codec.decode(nat) == spans
+
+    def test_name_lc_column(self):
+        spans = [Span(trace_id=1, name="GET", id=1),
+                 Span(trace_id=1, name="", id=2)]
+        dicts = DictionarySet()
+        nat, name_lc = native.parse_spans_columnar(payload_of(spans), dicts)
+        assert dicts.span_names.decode(int(name_lc[0])) == "get"
+        assert name_lc[1] == -1
+
+    def test_malformed_raises(self):
+        with pytest.raises(ValueError):
+            native.parse_spans_columnar(b"\xff\xff\xff", DictionarySet())
+
+    def test_base64(self):
+        import base64
+
+        raw = bytes(range(256))
+        assert native.base64_decode(base64.b64encode(raw)) == raw
+        with pytest.raises(ValueError):
+            native.base64_decode(b"!!!!")
+
+    def test_indexable_excludes_client_service(self):
+        cl = Endpoint(1, 1, "client")
+        spans = [
+            Span(trace_id=1, name="a", id=1,
+                 annotations=(Annotation(5, "cs", cl),)),
+            Span(trace_id=2, name="b", id=2,
+                 annotations=(Annotation(5, "sr", API),)),
+        ]
+        dicts = DictionarySet()
+        batch, _ = native.parse_spans_columnar(payload_of(spans), dicts)
+        idx = native.indexable_from_batch(batch, dicts)
+        np.testing.assert_array_equal(idx, [False, True])
+
+    def test_write_thrift_into_tpu_store(self):
+        from zipkin_tpu.store.device import StoreConfig
+        from zipkin_tpu.store.tpu import TpuSpanStore
+
+        cfg = StoreConfig(
+            capacity=1 << 9, ann_capacity=1 << 11, bann_capacity=1 << 10,
+            max_services=16, max_span_names=64, max_annotation_values=64,
+            max_binary_keys=16, cms_width=1 << 9, hll_p=6,
+            quantile_buckets=128,
+        )
+        store = TpuSpanStore(cfg)
+        spans = spans_fixture()
+        n = store.write_thrift(payload_of(spans))
+        assert n == 3
+        got = store.get_spans_by_trace_ids([-5])
+        assert got and got[0] == [spans[0]]
+        assert store.get_all_service_names() == {"web", "api"}

@@ -1,0 +1,78 @@
+"""SqliteSpanStore: the same conformance suite as every other backend
+(SpanStoreValidator reuse pattern) + the SQL dependency aggregator."""
+
+import pytest
+
+from zipkin_tpu.models.span import Annotation, Endpoint, Span
+from zipkin_tpu.store.sql import SqliteSpanStore
+from zipkin_tpu.testing.conformance import (
+    conformance_test_names,
+    run_conformance_test,
+)
+
+WEB = Endpoint(1, 80, "web")
+API = Endpoint(2, 80, "api")
+DB = Endpoint(3, 80, "db")
+
+
+@pytest.mark.parametrize("name", conformance_test_names())
+def test_sqlite_store_conformance(name):
+    run_conformance_test(name, SqliteSpanStore)
+
+
+def rpc(tid, sid, parent, client, server, t0, t1):
+    return Span(tid, "op", sid, parent, (
+        Annotation(t0, "cs", client),
+        Annotation(t0 + 1, "sr", server),
+        Annotation(t1 - 1, "ss", server),
+        Annotation(t1, "cr", client),
+    ))
+
+
+class TestSqlAggregator:
+    def test_join_and_moments(self):
+        store = SqliteSpanStore()
+        store.apply([
+            rpc(1, 1, None, WEB, API, 0, 1000),
+            rpc(1, 2, 1, API, DB, 100, 400),
+            rpc(2, 1, None, WEB, API, 5000, 6000),
+            rpc(2, 2, 1, API, DB, 5100, 5200),
+        ])
+        deps = store.aggregate_dependencies()
+        links = {(l.parent, l.child): l for l in deps.links}
+        assert set(links) == {("api", "db")}
+        m = links[("api", "db")].duration_moments
+        assert m.count == 2
+        assert m.mean == pytest.approx((300 + 100) / 2)
+
+    def test_incremental_resume(self):
+        store = SqliteSpanStore()
+        store.apply([
+            rpc(1, 1, None, WEB, API, 0, 1000),
+            rpc(1, 2, 1, API, DB, 100, 400),
+        ])
+        first = store.aggregate_dependencies()
+        assert sum(l.duration_moments.count for l in first.links) == 1
+        # Re-running without new data must not double-count.
+        again = store.aggregate_dependencies()
+        assert sum(l.duration_moments.count for l in again.links) == 1
+        # New spans after the watermark are picked up.
+        store.apply([
+            rpc(9, 1, None, WEB, API, 10_000, 11_000),
+            rpc(9, 2, 1, API, DB, 10_100, 10_500),
+        ])
+        third = store.aggregate_dependencies()
+        assert sum(l.duration_moments.count for l in third.links) == 2
+
+    def test_empty(self):
+        store = SqliteSpanStore()
+        assert store.get_dependencies().links == ()
+
+    def test_file_backed(self, tmp_path):
+        path = str(tmp_path / "spans.db")
+        store = SqliteSpanStore(path)
+        store.apply([rpc(1, 1, None, WEB, API, 0, 100)])
+        store.close()
+        reopened = SqliteSpanStore(path)
+        assert reopened.traces_exist([1]) == {1}
+        assert reopened.get_all_service_names() == {"web", "api"}

@@ -1,0 +1,218 @@
+"""Client-side instrumentation + query client (the zipkin-gems role).
+
+Reference: the Ruby ``ZipkinTracer::RackHandler``
+(zipkin-gems/zipkin-tracer/lib/zipkin-tracer.rb:7-45) — B3 header
+propagation, per-request server spans, percentage sampling, scribe
+transport — re-expressed for python:
+
+- ``B3Headers``: parse/emit X-B3-TraceId / X-B3-SpanId /
+  X-B3-ParentSpanId / X-B3-Sampled
+- ``Tracer``: span lifecycle + transport (any callable taking spans —
+  a Collector.accept, an HTTP poster, or a scribe sender)
+- ``ZipkinWSGIMiddleware``: wraps a WSGI app, continuing or starting a
+  trace per request with sr/ss annotations
+- ``QueryClient``: typed access to the HTTP query API
+  (the zipkin-query gem role)
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+from zipkin_tpu.models.constants import SERVER_RECV, SERVER_SEND
+from zipkin_tpu.models.span import Annotation, BinaryAnnotation, Endpoint, Span
+
+TRACE_ID_HEADER = "X-B3-TraceId"
+SPAN_ID_HEADER = "X-B3-SpanId"
+PARENT_ID_HEADER = "X-B3-ParentSpanId"
+SAMPLED_HEADER = "X-B3-Sampled"
+
+
+def _new_id(rng: random.Random) -> int:
+    return rng.getrandbits(63) + 1
+
+
+@dataclass(frozen=True)
+class B3Headers:
+    trace_id: Optional[int] = None
+    span_id: Optional[int] = None
+    parent_id: Optional[int] = None
+    sampled: Optional[bool] = None
+
+    @staticmethod
+    def parse(headers: Dict[str, str]) -> "B3Headers":
+        def hex_of(name):
+            v = headers.get(name) or headers.get(name.lower())
+            if v is None:
+                return None
+            try:
+                return int(v, 16)
+            except ValueError:
+                return None
+
+        sampled_raw = headers.get(SAMPLED_HEADER) or headers.get(
+            SAMPLED_HEADER.lower()
+        )
+        sampled = None
+        if sampled_raw is not None:
+            sampled = sampled_raw in ("1", "true", "True")
+        return B3Headers(
+            trace_id=hex_of(TRACE_ID_HEADER),
+            span_id=hex_of(SPAN_ID_HEADER),
+            parent_id=hex_of(PARENT_ID_HEADER),
+            sampled=sampled,
+        )
+
+    def emit(self) -> Dict[str, str]:
+        out = {}
+        if self.trace_id is not None:
+            out[TRACE_ID_HEADER] = f"{self.trace_id & (2**64 - 1):x}"
+        if self.span_id is not None:
+            out[SPAN_ID_HEADER] = f"{self.span_id & (2**64 - 1):x}"
+        if self.parent_id is not None:
+            out[PARENT_ID_HEADER] = f"{self.parent_id & (2**64 - 1):x}"
+        if self.sampled is not None:
+            out[SAMPLED_HEADER] = "1" if self.sampled else "0"
+        return out
+
+
+class Tracer:
+    """Creates spans and ships them through a transport callable."""
+
+    def __init__(
+        self,
+        service_name: str,
+        transport: Callable[[Sequence[Span]], None],
+        sample_rate: float = 1.0,
+        ipv4: int = 0x7F000001,
+        port: int = 0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.endpoint = Endpoint(ipv4, port, service_name)
+        self.transport = transport
+        self.sample_rate = sample_rate
+        self.rng = rng or random.Random()
+
+    def should_sample(self, b3: B3Headers) -> bool:
+        if b3.sampled is not None:
+            return b3.sampled
+        return self.rng.random() < self.sample_rate
+
+    def server_span(
+        self, name: str, b3: B3Headers,
+        start_us: Optional[int] = None, end_us: Optional[int] = None,
+        tags: Optional[Dict[str, str]] = None,
+    ) -> Optional[Span]:
+        """Record one server-side span (sr/ss) for a handled request."""
+        if not self.should_sample(b3):
+            return None
+        trace_id = b3.trace_id if b3.trace_id is not None else _new_id(self.rng)
+        span_id = b3.span_id if b3.span_id is not None else _new_id(self.rng)
+        start_us = start_us or int(time.time() * 1e6)
+        end_us = end_us or int(time.time() * 1e6)
+        banns = tuple(
+            BinaryAnnotation(k, v, host=self.endpoint)
+            for k, v in (tags or {}).items()
+        )
+        span = Span(
+            trace_id=trace_id, name=name, id=span_id, parent_id=b3.parent_id,
+            annotations=(
+                Annotation(start_us, SERVER_RECV, self.endpoint),
+                Annotation(end_us, SERVER_SEND, self.endpoint),
+            ),
+            binary_annotations=banns,
+        )
+        self.transport([span])
+        return span
+
+
+class ZipkinWSGIMiddleware:
+    """WSGI middleware: a server span per request (RackHandler role)."""
+
+    def __init__(self, app, tracer: Tracer):
+        self.app = app
+        self.tracer = tracer
+
+    def __call__(self, environ, start_response):
+        headers = {
+            k[5:].replace("_", "-"): v
+            for k, v in environ.items() if k.startswith("HTTP_")
+        }
+        b3 = B3Headers.parse(headers)
+        start_us = int(time.time() * 1e6)
+        path = environ.get("PATH_INFO", "/")
+        method = environ.get("REQUEST_METHOD", "GET")
+        status_holder: List[str] = []
+
+        def capture_start_response(status, resp_headers, exc_info=None):
+            status_holder.append(status)
+            return start_response(status, resp_headers, exc_info)
+
+        try:
+            return self.app(environ, capture_start_response)
+        finally:
+            self.tracer.server_span(
+                f"{method.lower()} {path}",
+                b3,
+                start_us=start_us,
+                end_us=int(time.time() * 1e6),
+                tags={
+                    "http.uri": path,
+                    "http.method": method,
+                    "http.status": (status_holder[0].split()[0]
+                                    if status_holder else "?"),
+                },
+            )
+
+
+def http_transport(base_url: str) -> Callable[[Sequence[Span]], None]:
+    """Transport posting JSON spans to a collector's /api/spans door."""
+    from zipkin_tpu.ingest.receiver import span_to_json
+
+    def send(spans: Sequence[Span]) -> None:
+        body = json.dumps([span_to_json(s) for s in spans]).encode()
+        req = urllib.request.Request(
+            base_url.rstrip("/") + "/api/spans", data=body, method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+
+    return send
+
+
+class QueryClient:
+    """Typed client for the HTTP query API (zipkin-query gem role)."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str):
+        with urllib.request.urlopen(
+            self.base_url + path, timeout=self.timeout
+        ) as r:
+            return json.loads(r.read())
+
+    def services(self) -> List[str]:
+        return self._get("/api/services")
+
+    def span_names(self, service: str) -> List[str]:
+        return self._get(f"/api/spans?serviceName={service}")
+
+    def query(self, service: str, **params) -> dict:
+        qs = "&".join(
+            [f"serviceName={service}"]
+            + [f"{k}={v}" for k, v in params.items()]
+        )
+        return self._get(f"/api/query?{qs}")
+
+    def trace(self, trace_id: int) -> List[dict]:
+        return self._get(f"/api/trace/{trace_id}")
+
+    def dependencies(self) -> dict:
+        return self._get("/api/dependencies")

@@ -1,0 +1,298 @@
+"""ctypes bindings for the native span parser (native/span_codec.cc).
+
+The C++ parser turns a raw thrift Span sequence into columnar numpy
+arrays in one pass — the native fast path for the collector's hot
+decode (reference role: scrooge's binary deserializer on
+ScribeSpanReceiver.scala:96-107). String fields come back as
+(offset, length) slices into the input buffer; the host interns them
+through the shared DictionarySet so device ids stay consistent.
+
+The library is built on demand with g++ (cached next to the source);
+callers must handle ``NativeUnavailable`` and fall back to the pure
+python codec (zipkin_tpu.wire.thrift) — see ``parse_spans_columnar``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from zipkin_tpu.columnar.dictionary import DictionarySet
+from zipkin_tpu.columnar.schema import (
+    FLAG_DEBUG,
+    FLAG_HAS_PARENT,
+    NO_ENDPOINT,
+    NO_SERVICE,
+    NO_TS,
+    SpanBatch,
+)
+from zipkin_tpu.models.constants import (
+    CLIENT_RECV,
+    CLIENT_SEND,
+    SERVER_RECV,
+    SERVER_SEND,
+)
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "span_codec.cc")
+_SO = os.path.join(os.path.dirname(_SRC), "libzipkin_native.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+class _SpanColumns(ctypes.Structure):
+    _fields_ = [(name, ctypes.c_void_p) for name in (
+        "trace_id", "span_id", "parent_id", "has_parent", "debug",
+        "name_off", "name_len",
+        "ann_span_idx", "ann_ts", "ann_value_off", "ann_value_len",
+        "ann_ipv4", "ann_port", "ann_svc_off", "ann_svc_len",
+        "bann_span_idx", "bann_key_off", "bann_key_len",
+        "bann_value_off", "bann_value_len", "bann_type",
+        "bann_ipv4", "bann_port", "bann_svc_off", "bann_svc_len",
+    )]
+
+
+def _build() -> str:
+    if os.path.exists(_SO) and (
+        os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    ):
+        return _SO
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-Wall", "-shared", "-fPIC", "-std=c++17",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        raise NativeUnavailable(f"could not build native codec: {e}") from e
+    return _SO
+
+
+def get_lib():
+    global _lib
+    with _lock:
+        if _lib is None:
+            path = _build()
+            lib = ctypes.CDLL(path)
+            lib.zk_parse_spans.restype = ctypes.c_int
+            lib.zk_parse_spans.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(_SpanColumns),
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.zk_base64_decode.restype = ctypes.c_int64
+            lib.zk_base64_decode.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+            ]
+            _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    try:
+        get_lib()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def base64_decode(data: bytes) -> bytes:
+    lib = get_lib()
+    out = ctypes.create_string_buffer((len(data) * 3) // 4 + 4)
+    n = lib.zk_base64_decode(data, len(data), out)
+    if n < 0:
+        raise ValueError("bad base64 payload")
+    return out.raw[:n]
+
+
+_CORE_TS = {CLIENT_SEND: "ts_cs", CLIENT_RECV: "ts_cr",
+            SERVER_RECV: "ts_sr", SERVER_SEND: "ts_ss"}
+
+
+def indexable_from_batch(batch: SpanBatch, dicts: DictionarySet) -> np.ndarray:
+    """Columnar should_index (store/base.py:51): exclude spans that are
+    client-side and carry the literal service name "client"."""
+    ns = batch.n_spans
+    out = np.ones(ns, bool)
+    client_svc = dicts.services.get("client")
+    if client_svc is None or ns == 0:
+        return out
+    cs_id, cr_id = 0, 1  # CORE_ANNOTATION_IDS cs/cr
+    is_core_client = np.isin(batch.ann_value_id, (cs_id, cr_id))
+    has_client_side = np.zeros(ns, bool)
+    np.logical_or.at(has_client_side, batch.ann_span_idx[is_core_client], True)
+    svc_is_client = batch.ann_service_id == client_svc
+    has_client_svc = np.zeros(ns, bool)
+    np.logical_or.at(has_client_svc, batch.ann_span_idx[svc_is_client], True)
+    out &= ~(has_client_side & has_client_svc)
+    return out
+
+
+def parse_spans_columnar(
+    payload: bytes, dicts: DictionarySet,
+    max_spans: int = 1 << 16,
+) -> Tuple[SpanBatch, np.ndarray]:
+    """Thrift Span sequence → (SpanBatch, name_lc_id column).
+
+    The numeric work happens in C++; this wrapper interns strings and
+    assembles the SpanBatch. Raises NativeUnavailable when the shared
+    object can't be built; ValueError on malformed input.
+    """
+    lib = get_lib()
+    max_anns = max_spans * 8
+    max_banns = max_spans * 8
+
+    cols = {}
+
+    def arr(name, n, dtype):
+        a = np.zeros(n, dtype)
+        cols[name] = a
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    sc = _SpanColumns(
+        trace_id=arr("trace_id", max_spans, np.int64),
+        span_id=arr("span_id", max_spans, np.int64),
+        parent_id=arr("parent_id", max_spans, np.int64),
+        has_parent=arr("has_parent", max_spans, np.uint8),
+        debug=arr("debug", max_spans, np.uint8),
+        name_off=arr("name_off", max_spans, np.int64),
+        name_len=arr("name_len", max_spans, np.int32),
+        ann_span_idx=arr("ann_span_idx", max_anns, np.int32),
+        ann_ts=arr("ann_ts", max_anns, np.int64),
+        ann_value_off=arr("ann_value_off", max_anns, np.int64),
+        ann_value_len=arr("ann_value_len", max_anns, np.int32),
+        ann_ipv4=arr("ann_ipv4", max_anns, np.int32),
+        ann_port=arr("ann_port", max_anns, np.int32),
+        ann_svc_off=arr("ann_svc_off", max_anns, np.int64),
+        ann_svc_len=arr("ann_svc_len", max_anns, np.int32),
+        bann_span_idx=arr("bann_span_idx", max_banns, np.int32),
+        bann_key_off=arr("bann_key_off", max_banns, np.int64),
+        bann_key_len=arr("bann_key_len", max_banns, np.int32),
+        bann_value_off=arr("bann_value_off", max_banns, np.int64),
+        bann_value_len=arr("bann_value_len", max_banns, np.int32),
+        bann_type=arr("bann_type", max_banns, np.int32),
+        bann_ipv4=arr("bann_ipv4", max_banns, np.int32),
+        bann_port=arr("bann_port", max_banns, np.int32),
+        bann_svc_off=arr("bann_svc_off", max_banns, np.int64),
+        bann_svc_len=arr("bann_svc_len", max_banns, np.int32),
+    )
+    n_spans = ctypes.c_int32(0)
+    n_anns = ctypes.c_int32(0)
+    n_banns = ctypes.c_int32(0)
+    rc = lib.zk_parse_spans(
+        payload, len(payload), ctypes.byref(sc),
+        max_spans, max_anns, max_banns,
+        ctypes.byref(n_spans), ctypes.byref(n_anns), ctypes.byref(n_banns),
+    )
+    if rc == -1:
+        raise ValueError("malformed thrift span payload")
+    if rc in (-2, -3, -4):
+        raise ValueError("payload exceeds parse capacity; chunk the input")
+    ns, na, nb = n_spans.value, n_anns.value, n_banns.value
+
+    b = SpanBatch.empty(ns, na, nb)
+    b.trace_id[:] = cols["trace_id"][:ns]
+    b.span_id[:] = cols["span_id"][:ns]
+    b.parent_id[:] = cols["parent_id"][:ns]
+    b.flags[:] = (
+        cols["has_parent"][:ns] * np.uint8(FLAG_HAS_PARENT)
+        + cols["debug"][:ns] * np.uint8(FLAG_DEBUG)
+    )
+
+    mem = payload  # bytes: slicing is cheap
+
+    def intern(off, length, dictionary, decode_utf8=True):
+        raw = mem[off:off + length]
+        return dictionary.encode(
+            raw.decode("utf-8", "replace") if decode_utf8 else raw
+        )
+
+    name_lc = np.empty(ns, np.int32)
+    for i in range(ns):
+        raw = mem[int(cols["name_off"][i]):
+                  int(cols["name_off"][i]) + int(cols["name_len"][i])]
+        name = raw.decode("utf-8", "replace")
+        b.name_id[i] = dicts.span_names.encode(name)
+        name_lc[i] = (
+            -1 if name == "" else dicts.span_names.encode(name.lower())
+        )
+
+    # Annotation table + per-span core-ts columns and owning service.
+    server_svc = np.full(ns, NO_SERVICE, np.int64)
+    client_svc = np.full(ns, NO_SERVICE, np.int64)
+    for j in range(na):
+        si = int(cols["ann_span_idx"][j])
+        ts = int(cols["ann_ts"][j])
+        voff, vlen = int(cols["ann_value_off"][j]), int(cols["ann_value_len"][j])
+        value = mem[voff:voff + vlen].decode("utf-8", "replace")
+        b.ann_span_idx[j] = si
+        b.ann_ts[j] = ts
+        b.ann_value_id[j] = dicts.annotations.encode(value)
+        slen = int(cols["ann_svc_len"][j])
+        if slen >= 0:
+            soff = int(cols["ann_svc_off"][j])
+            svc_name = mem[soff:soff + slen].decode("utf-8", "replace")
+            svc_id = dicts.services.encode(svc_name.lower())
+            b.ann_service_id[j] = svc_id
+            b.ann_endpoint_id[j] = dicts.endpoints.encode(
+                (int(cols["ann_ipv4"][j]), int(cols["ann_port"][j]), svc_name)
+            )
+            if value in (SERVER_RECV, SERVER_SEND) and server_svc[si] < 0:
+                server_svc[si] = svc_id
+            elif value in (CLIENT_SEND, CLIENT_RECV) and client_svc[si] < 0:
+                client_svc[si] = svc_id
+        core_col = _CORE_TS.get(value)
+        if core_col is not None:
+            getattr(b, core_col)[si] = ts
+        if b.ts_first[si] == NO_TS or ts < b.ts_first[si]:
+            b.ts_first[si] = ts
+        if b.ts_last[si] == NO_TS or ts > b.ts_last[si]:
+            b.ts_last[si] = ts
+
+    has_ts = b.ts_first != NO_TS
+    b.duration[has_ts] = b.ts_last[has_ts] - b.ts_first[has_ts]
+    b.service_id[:] = np.where(
+        server_svc >= 0, server_svc,
+        np.where(client_svc >= 0, client_svc, NO_SERVICE),
+    ).astype(np.int32)
+
+    for j in range(nb):
+        b.bann_span_idx[j] = int(cols["bann_span_idx"][j])
+        koff, klen = int(cols["bann_key_off"][j]), int(cols["bann_key_len"][j])
+        b.bann_key_id[j] = dicts.binary_keys.encode(
+            mem[koff:koff + klen].decode("utf-8", "replace")
+        )
+        voff, vlen = int(cols["bann_value_off"][j]), int(cols["bann_value_len"][j])
+        btype = int(cols["bann_type"][j])
+        b.bann_type[j] = btype if 0 <= btype <= 6 else 1
+        from zipkin_tpu.wire.thrift import _decode_binary_value
+        from zipkin_tpu.models.span import AnnotationType
+
+        value = _decode_binary_value(
+            mem[voff:voff + vlen], AnnotationType(int(b.bann_type[j]))
+        )
+        if isinstance(value, bytearray):
+            value = bytes(value)
+        b.bann_value_id[j] = dicts.binary_values.encode(value)
+        slen = int(cols["bann_svc_len"][j])
+        if slen >= 0:
+            soff = int(cols["bann_svc_off"][j])
+            svc_name = mem[soff:soff + slen].decode("utf-8", "replace")
+            b.bann_service_id[j] = dicts.services.encode(svc_name.lower())
+            b.bann_endpoint_id[j] = dicts.endpoints.encode(
+                (int(cols["bann_ipv4"][j]), int(cols["bann_port"][j]), svc_name)
+            )
+    return b, name_lc

@@ -1,0 +1,136 @@
+"""Pallas TPU kernels for the scatter-heavy ingest ops.
+
+The fused ingest step is dominated by scatter-adds into modest-size
+count arrays (per-service histograms [S*B], count-min rows [D*W],
+presence matrices). XLA lowers scatter-add to a sort+segment pipeline
+through HBM; these kernels instead keep the whole count array resident
+in VMEM and apply updates with on-chip scalar stores — grid steps run
+sequentially on a TPU core, so the output block accumulates across
+tiles without atomics (pallas_guide.md: grids are sequential; revisited
+blocks stay in VMEM).
+
+The count array must fit VMEM (~16MB): S*B = 256×2048 f32 = 2MB and
+CMS 4×65536 i32 = 1MB both do. On CPU the kernels run in interpreter
+mode (tests); on TPU they compile natively. ``flat_histogram`` is the
+generic primitive; ``cms_update`` reuses it per sketch row.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANES = 128
+DEFAULT_TILE = 1024
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _hist_kernel(idx_ref, w_ref, out_ref, *, tile: int):
+    # idx_ref/w_ref blocks are (1, 8, tile//8) to satisfy the TPU
+    # (sublane, lane) tiling; iterate the tile in flat order.
+    i = pl.program_id(0)
+    sub = tile // LANES
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    # Shift/mask instead of //,% — LANES is 128 — and int32 loop bounds:
+    # pallas TPU has no 64-bit lowering, and x64 mode would make a plain
+    # python-int fori_loop index int64. Mosaic cannot store scalars to
+    # VMEM, so each update is a row-granular read-modify-write with a
+    # one-hot lane add.
+    def body(t, carry):
+        tr = t >> 7
+        tc = t & 127
+        b = idx_ref[0, tr, tc]
+
+        @pl.when(b >= 0)
+        def _():
+            r = b >> 7
+            c = b & 127
+            row = out_ref[pl.ds(r, 1), :]
+            lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+            onehot = (lane == c).astype(row.dtype) * w_ref[0, tr, tc]
+            out_ref[pl.ds(r, 1), :] = row + onehot
+
+        return carry
+
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(tile), body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "tile"))
+def flat_histogram(idx, weights, m: int, tile: int = DEFAULT_TILE):
+    """Scatter-add ``weights`` at flat positions ``idx`` into a length-m
+    array (m must be a multiple of 128). Negative idx rows are dropped.
+
+    Returns the [m] histogram delta (caller adds it to running state).
+    """
+    assert m % LANES == 0, "histogram size must be a multiple of 128"
+    assert tile % LANES == 0, "tile must be a multiple of 128"
+    sub = tile // LANES
+    n = idx.shape[0]
+    n_tiles = -(-n // tile)
+    pad = n_tiles * tile - n
+    idx = jnp.pad(jnp.asarray(idx, jnp.int32), (0, pad), constant_values=-1)
+    weights = jnp.pad(jnp.asarray(weights), (0, pad))
+    idx3 = idx.reshape(n_tiles, sub, LANES)
+    w3 = weights.reshape(n_tiles, sub, LANES)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, tile=tile),
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, sub, LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, sub, LANES), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((m // LANES, LANES), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((m // LANES, LANES), w3.dtype),
+        interpret=_interpret(),
+    )(idx3, w3)
+    return out.reshape(m)
+
+
+def histogram_update(counts, idx, weights=None, tile: int = DEFAULT_TILE):
+    """counts[m] += scatter(idx, weights) via the VMEM-resident kernel."""
+    m = counts.shape[-1] if counts.ndim == 1 else counts.size
+    flat = counts.reshape(-1)
+    if weights is None:
+        weights = jnp.ones(idx.shape, flat.dtype)
+    delta = flat_histogram(idx, weights.astype(flat.dtype), int(m), tile)
+    return (flat + delta).reshape(counts.shape)
+
+
+def cms_update(counts, idx_rows, weights=None, tile: int = DEFAULT_TILE):
+    """Count-min update: counts [D, W] += per-row scatter of idx_rows
+    [D, N] (bucket per key per row). One flat histogram over D*W."""
+    d, w = counts.shape
+    n = idx_rows.shape[1]
+    flat_idx = (
+        idx_rows + (jnp.arange(d, dtype=jnp.int32) * w)[:, None]
+    ).reshape(-1)
+    flat_idx = jnp.where(idx_rows.reshape(-1) >= 0, flat_idx, -1)
+    if weights is None:
+        wts = jnp.ones(d * n, counts.dtype)
+    else:
+        wts = jnp.broadcast_to(weights, (d, n)).reshape(-1).astype(counts.dtype)
+    delta = flat_histogram(flat_idx, wts, d * w, tile)
+    return counts + delta.reshape(d, w)
+
+
+def scatter_histogram_xla(counts, idx, weights=None):
+    """XLA reference path (what store/device.py uses today); kept for
+    benchmarking the pallas kernel against on real hardware."""
+    flat = counts.reshape(-1)
+    m = flat.shape[0]
+    if weights is None:
+        weights = jnp.ones(idx.shape, flat.dtype)
+    safe = jnp.where(idx >= 0, idx, m)
+    out = jnp.concatenate([flat, jnp.zeros(1, flat.dtype)])
+    out = out.at[safe].add(weights.astype(flat.dtype))
+    return out[:m].reshape(counts.shape)

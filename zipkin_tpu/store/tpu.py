@@ -137,6 +137,32 @@ class TpuSpanStore(SpanStore):
                 del self.ttls[tid]
                 excess -= 1
 
+    def write_thrift(self, payload: bytes) -> int:
+        """Native fast path: raw thrift Span sequence → device, bypassing
+        python span objects entirely. Returns the span count written.
+        Raises zipkin_tpu.native.NativeUnavailable when g++ is absent —
+        callers fall back to wire.thrift + apply()."""
+        from zipkin_tpu import native
+
+        with self._lock:
+            batch, name_lc = native.parse_spans_columnar(
+                payload, self.dicts, max_spans=self.MAX_CHUNK
+            )
+            if batch.n_spans == 0:
+                return 0
+            for tid in np.unique(batch.trace_id):
+                self.ttls[int(tid)] = 1.0
+            self._prune_ttls()
+            indexable = native.indexable_from_batch(batch, self.dicts)
+            db = dev.make_device_batch(
+                batch, name_lc_id=name_lc, indexable=indexable,
+                pad_spans=_next_pow2(batch.n_spans),
+                pad_anns=_next_pow2(batch.n_annotations),
+                pad_banns=_next_pow2(batch.n_binary),
+            )
+            self.state = dev.ingest_step(self.state, db)
+            return batch.n_spans
+
     def write_batch(self, batch: SpanBatch, indexable: np.ndarray) -> None:
         """Upload one columnar batch and run the fused ingest step.
 
