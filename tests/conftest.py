@@ -10,13 +10,13 @@ we also flip the config explicitly before any backend initializes.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _force_cpu_mesh_env  # noqa: E402
+
+_force_cpu_mesh_env(8, os.environ)
 
 import jax  # noqa: E402
 

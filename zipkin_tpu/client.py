@@ -46,8 +46,13 @@ class B3Headers:
 
     @staticmethod
     def parse(headers: Dict[str, str]) -> "B3Headers":
+        # HTTP header names are case-insensitive (and WSGI's HTTP_*
+        # environ keys arrive fully uppercased), so match on a
+        # lowercased view of the mapping.
+        lowered = {k.lower(): v for k, v in headers.items()}
+
         def hex_of(name):
-            v = headers.get(name) or headers.get(name.lower())
+            v = lowered.get(name.lower())
             if v is None:
                 return None
             try:
@@ -55,11 +60,9 @@ class B3Headers:
             except ValueError:
                 return None
 
-        sampled_raw = headers.get(SAMPLED_HEADER) or headers.get(
-            SAMPLED_HEADER.lower()
-        )
+        sampled_raw = lowered.get(SAMPLED_HEADER.lower())
         sampled = None
-        if sampled_raw is not None:
+        if sampled_raw:
             sampled = sampled_raw in ("1", "true", "True")
         return B3Headers(
             trace_id=hex_of(TRACE_ID_HEADER),
