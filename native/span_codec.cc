@@ -69,7 +69,12 @@ struct Reader {
     *n = sz;
     return off;
   }
-  void skip(int t) {
+  // Depth-bounded: a crafted payload of deeply nested containers on the
+  // network-facing ingest path must fail the parse, not blow the C stack.
+  static constexpr int kMaxSkipDepth = 64;
+
+  void skip(int t, int depth = 0) {
+    if (depth > kMaxSkipDepth) { ok = false; return; }
     switch (t) {
       case T_BOOL: case T_BYTE: need(1); pos += 1; break;
       case T_I16: need(2); pos += 2; break;
@@ -81,20 +86,22 @@ struct Reader {
           uint8_t ft = u8();
           if (ft == T_STOP) break;
           i16();
-          skip(ft);
+          skip(ft, depth + 1);
         }
         break;
       }
       case T_LIST: case T_SET: {
         uint8_t et = u8();
         int32_t n = i32();
-        for (int32_t i = 0; i < n && ok; i++) skip(et);
+        for (int32_t i = 0; i < n && ok; i++) skip(et, depth + 1);
         break;
       }
       case T_MAP: {
         uint8_t kt = u8(), vt = u8();
         int32_t n = i32();
-        for (int32_t i = 0; i < n && ok; i++) { skip(kt); skip(vt); }
+        for (int32_t i = 0; i < n && ok; i++) {
+          skip(kt, depth + 1); skip(vt, depth + 1);
+        }
         break;
       }
       default: ok = false;
@@ -106,12 +113,12 @@ struct Endpoint {
   int32_t ipv4 = 0;
   int32_t port = 0;
   int64_t svc_off = 0;
-  int32_t svc_len = -1;  // -1: absent
+  int32_t svc_len = -1;  // -1: no endpoint; -2: endpoint w/o service_name
 };
 
 Endpoint read_endpoint(Reader& r) {
   Endpoint ep;
-  ep.svc_len = 0;
+  ep.svc_len = -2;
   while (r.ok) {
     uint8_t ft = r.u8();
     if (ft == T_STOP) break;
@@ -147,7 +154,7 @@ struct SpanColumns {
   int32_t* ann_ipv4;
   int32_t* ann_port;
   int64_t* ann_svc_off;
-  int32_t* ann_svc_len;  // -1: no host
+  int32_t* ann_svc_len;  // -1: no host; -2: host w/o service_name
   // binary annotation table
   int32_t* bann_span_idx;
   int64_t* bann_key_off;
@@ -158,7 +165,7 @@ struct SpanColumns {
   int32_t* bann_ipv4;
   int32_t* bann_port;
   int64_t* bann_svc_off;
-  int32_t* bann_svc_len;  // -1: no host
+  int32_t* bann_svc_len;  // -1: no host; -2: host w/o service_name
 };
 
 // Parse a back-to-back sequence of thrift Span structs.

@@ -1,0 +1,174 @@
+"""Robustness fixes from the round-1 advisory: depth-bounded thrift
+skip, native/python codec parity on unnamed endpoints, TTL key
+canonicalization, and store concurrency (RWLock)."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from zipkin_tpu.concurrency import RWLock
+from zipkin_tpu.models.span import Annotation, Endpoint, Span
+from zipkin_tpu.store import device as dev
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.wire.thrift import ThriftError, span_to_bytes, spans_from_bytes
+
+T_STOP, T_I64, T_STRING, T_STRUCT = 0, 10, 11, 12
+
+
+def nested_struct_payload(depth: int) -> bytes:
+    """A Span struct whose unknown field nests structs ``depth`` deep."""
+    out = [struct.pack(">bh", T_I64, 1), struct.pack(">q", 1)]  # trace_id
+    for _ in range(depth):
+        out.append(struct.pack(">bh", T_STRUCT, 99))
+    out.append(b"\x00" * (depth + 1))  # close every struct + the span
+    return b"".join(out)
+
+
+def unnamed_endpoint_payload() -> bytes:
+    """A Span with one annotation whose endpoint has no service_name."""
+    ep = struct.pack(">bh", 8, 1) + struct.pack(">i", 0x0A000001)
+    ep += struct.pack(">bh", 6, 2) + struct.pack(">h", 80)
+    ep += b"\x00"
+    ann = struct.pack(">bh", T_I64, 1) + struct.pack(">q", 123)
+    ann += struct.pack(">bh", T_STRING, 2) + struct.pack(">i", 2) + b"sr"
+    ann += struct.pack(">bh", T_STRUCT, 3) + ep
+    ann += b"\x00"
+    span = struct.pack(">bh", T_I64, 1) + struct.pack(">q", 9)
+    span += struct.pack(">bh", T_STRING, 3) + struct.pack(">i", 1) + b"x"
+    span += struct.pack(">bh", T_I64, 4) + struct.pack(">q", 10)
+    span += struct.pack(">bh", 15, 6) + struct.pack(">bi", T_STRUCT, 1) + ann
+    span += b"\x00"
+    return span
+
+
+class TestSkipDepthBound:
+    def test_python_parser_rejects_deep_nesting(self):
+        # Deep enough that unbounded recursion would raise RecursionError.
+        payload = nested_struct_payload(5000)
+        with pytest.raises(ThriftError):
+            spans_from_bytes(payload)
+
+    def test_python_parser_accepts_shallow_unknown_structs(self):
+        spans = spans_from_bytes(nested_struct_payload(10))
+        assert len(spans) == 1 and spans[0].trace_id == 1
+
+    def test_native_parser_rejects_deep_nesting(self):
+        native = pytest.importorskip("zipkin_tpu.native")
+        if not native.available():
+            pytest.skip("native codec unavailable")
+        from zipkin_tpu.columnar.dictionary import DictionarySet
+
+        with pytest.raises(ValueError):
+            native.parse_spans_columnar(
+                nested_struct_payload(10_000), DictionarySet()
+            )
+
+
+class TestUnnamedEndpointParity:
+    def test_python_defaults_to_unknown(self):
+        spans = spans_from_bytes(unnamed_endpoint_payload())
+        assert spans[0].annotations[0].host.service_name == "unknown"
+
+    def test_native_matches_python_default(self):
+        native = pytest.importorskip("zipkin_tpu.native")
+        if not native.available():
+            pytest.skip("native codec unavailable")
+        from zipkin_tpu.columnar.dictionary import DictionarySet
+
+        dicts = DictionarySet()
+        batch, _ = native.parse_spans_columnar(
+            unnamed_endpoint_payload(), dicts
+        )
+        assert batch.n_annotations == 1
+        svc_id = int(batch.ann_service_id[0])
+        assert dicts.services.decode(svc_id) == "unknown"
+
+
+def small_store():
+    return TpuSpanStore(dev.StoreConfig(
+        capacity=256, ann_capacity=1024, bann_capacity=512,
+        max_services=16, max_span_names=32, max_annotation_values=64,
+        max_binary_keys=16, cms_width=256, hll_p=6, quantile_buckets=128,
+    ))
+
+
+def make_span(tid: int, sid: int) -> Span:
+    ep = Endpoint(1, 80, "svc")
+    ts = (tid % 1000) * 10
+    return Span(trace_id=tid, name="op", id=sid,
+                annotations=(Annotation(ts + 1, "sr", ep),
+                             Annotation(ts + 5, "ss", ep)))
+
+
+class TestTtlKeyCanonicalization:
+    def test_unsigned_trace_id_ttl_roundtrip(self):
+        store = small_store()
+        big = 2**63 + 17  # arrives unsigned on the wire
+        store.apply([make_span(big, 1)])
+        assert store.get_time_to_live(big) == 1.0
+        store.set_time_to_live(big, 3600.0)
+        assert store.get_time_to_live(big) == 3600.0
+        # The signed alias of the same id resolves to the same entry.
+        assert store.get_time_to_live(big - 2**64) == 3600.0
+
+    def test_rewrite_does_not_reset_pin(self):
+        store = small_store()
+        store.apply([make_span(5, 1)])
+        store.set_time_to_live(5, 7200.0)
+        store.apply([make_span(5, 2)])  # more spans of the pinned trace
+        assert store.get_time_to_live(5) == 7200.0
+
+
+class TestRWLock:
+    def test_writer_excludes_readers(self):
+        lock = RWLock()
+        order = []
+        with lock.read():
+            order.append("r1")
+        t_done = threading.Event()
+
+        def writer():
+            with lock.write():
+                order.append("w")
+            t_done.set()
+
+        with lock.read():
+            t = threading.Thread(target=writer)
+            t.start()
+            time.sleep(0.05)
+            assert "w" not in order  # writer blocked behind reader
+        assert t_done.wait(2)
+        assert order == ["r1", "w"]
+
+    def test_concurrent_ingest_and_query(self):
+        """Queries interleaved with donating ingest steps must neither
+        deadlock nor crash (ADVICE r1 high)."""
+        store = small_store()
+        store.apply([make_span(1, 1)])
+        errors = []
+        stop = threading.Event()
+
+        def query_loop():
+            try:
+                while not stop.is_set():
+                    store.get_spans_by_trace_ids([1, 2, 3])
+                    store.get_trace_ids_by_name("svc", None, 10**15, 5)
+                    store.counters()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=query_loop) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(2, 30):
+                store.apply([make_span(i, j) for j in range(1, 4)])
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(10)
+        assert not errors
+        got = store.get_spans_by_trace_ids([29])
+        assert got and len(got[0]) == 3

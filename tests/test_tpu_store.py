@@ -111,6 +111,40 @@ class TestAnalytics:
         link = {(l.parent, l.child): l for l in deps.links}[("a", "b")]
         assert link.duration_moments.count == 2
 
+    def test_cross_batch_parent_child_links(self):
+        """Parent and child arriving in separate payloads must still
+        produce their dependency link (ADVICE r1: the within-batch-only
+        join silently dropped these — the normal case across services)."""
+        store = small_store()
+        store.apply([_rpc(7, 1, None, "w", "a", 0, 1000)])  # parent alone
+        store.apply([_rpc(7, 2, 1, "a", "b", 100, 300)])    # child later
+        store.apply([_rpc(8, 2, 1, "a", "b", 100, 500)])    # child first
+        store.apply([_rpc(8, 1, None, "w", "a", 0, 1000)])  # parent later
+        deps = store.get_dependencies()
+        link = {(l.parent, l.child): l for l in deps.links}[("a", "b")]
+        assert link.duration_moments.count == 2
+        assert link.duration_moments.mean == pytest.approx(300.0)
+
+    def test_cross_batch_links_survive_archive(self):
+        """Links counted before eviction stay counted after the child is
+        evicted from the ring (archive watermark path)."""
+        cfg = StoreConfig(
+            capacity=8, ann_capacity=64, bann_capacity=32,
+            max_services=8, max_span_names=16, max_annotation_values=32,
+            max_binary_keys=8, cms_width=256, hll_p=4, quantile_buckets=64,
+        )
+        store = TpuSpanStore(cfg)
+        # Parent and child in separate batches, then enough traffic to
+        # wrap the 8-row ring several times.
+        store.apply([_rpc(1, 1, None, "w", "a", 0, 1000)])
+        store.apply([_rpc(1, 2, 1, "a", "b", 100, 300)])
+        for t in range(2, 34):
+            store.apply([_rpc(t, 1, None, "w", "s", 0, 50)])
+        deps = store.get_dependencies()
+        got = {(l.parent, l.child): l for l in deps.links}
+        assert ("a", "b") in got
+        assert got[("a", "b")].duration_moments.count == 1
+
 
 class TestReviewRegressions:
     def test_str_binary_value_found_by_bytes_query(self):

@@ -91,12 +91,16 @@ def recompute_dependencies(tpu_store) -> Dependencies:
     Only sees spans still in retention, unlike the streaming bank."""
     from zipkin_tpu.store.device import recompute_dep_moments
 
+    with tpu_store._rw.read():
+        st = tpu_store.state
+        bank = np.asarray(recompute_dep_moments(st))
+        ts_min, ts_max = float(st.ts_min), float(st.ts_max)
     return dependencies_from_bank(
-        recompute_dep_moments(tpu_store.state),
+        bank,
         tpu_store.dicts.services,
         tpu_store.config.max_services,
-        float(tpu_store.state.ts_min),
-        float(tpu_store.state.ts_max),
+        ts_min,
+        ts_max,
     )
 
 

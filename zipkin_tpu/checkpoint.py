@@ -59,13 +59,17 @@ def _dict_load(dictionary, values: list) -> None:
 def save(store: TpuSpanStore, path: str) -> None:
     """Snapshot a TpuSpanStore to ``path`` (a directory), atomically."""
     leaves = {}
-    for name in dev.StoreState._FIELDS:
-        value = getattr(store.state, name)
-        if name == "counters":
-            for k, v in value.items():
-                leaves[f"counters.{k}"] = np.asarray(v)
-        else:
-            leaves[name] = np.asarray(value)
+    # Hold the read lock while gathering: ingest donates the previous
+    # state's buffers, so an unguarded snapshot could read freed memory.
+    with store._rw.read():
+        state = store.state
+        for name in dev.StoreState._FIELDS:
+            value = getattr(state, name)
+            if name == "counters":
+                for k, v in value.items():
+                    leaves[f"counters.{k}"] = np.asarray(v)
+            else:
+                leaves[name] = np.asarray(value)
     meta = {
         "config": store.config._asdict(),
         "ttls": {str(k): v for k, v in store.ttls.items()},
@@ -144,5 +148,9 @@ def load(path: str) -> TpuSpanStore:
         else:
             upd[key] = jax.numpy.asarray(data[key])
     upd["counters"] = counters
-    store.state = store.state.replace(**upd)
+    with store._rw.write():
+        store.state = store.state.replace(**upd)
+    # Re-seed the host mirrors that drive the dependency-archive policy.
+    store._wp = int(store.state.write_pos)
+    store._archived = int(store.state.dep_archived_gid)
     return store

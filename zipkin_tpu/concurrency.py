@@ -1,0 +1,58 @@
+"""Concurrency primitives for host-side store coordination.
+
+The reference leans on ArrayBlockingQueue / synchronized / immutable
+data for thread safety (SURVEY.md §5 "race detection"). Our device
+store has one extra hazard the JVM design doesn't: ``ingest_step``
+donates the previous state's device buffers (buffer donation is how the
+ring update stays allocation-free), so a query that is still reading a
+snapshot of the old state can see its buffers deleted mid-kernel.
+
+``RWLock`` makes the swap safe: queries hold a read lock across their
+kernel launches and host gathers; ingest takes the write lock to run
+the donating step and swap the state pointer. Writer-preference keeps
+the hot ingest path from starving behind a stream of queries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class RWLock:
+    """Writer-preference readers/writer lock (non-reentrant)."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writers_waiting = 0
+        self._writer = False
+
+    @contextlib.contextmanager
+    def read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writers_waiting -= 1
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()

@@ -62,8 +62,8 @@ class _SpanColumns(ctypes.Structure):
     )]
 
 
-def _build() -> str:
-    if os.path.exists(_SO) and (
+def _build(force: bool = False) -> str:
+    if not force and os.path.exists(_SO) and (
         os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
     ):
         return _SO
@@ -78,12 +78,29 @@ def _build() -> str:
     return _SO
 
 
+def _load() -> ctypes.CDLL:
+    """Build (if stale) and dlopen, rebuilding once on a load failure —
+    a stale or wrong-arch .so from a previous checkout must fall through
+    to a fresh build, and a still-failing load must surface as
+    NativeUnavailable so callers engage the pure-python fallback."""
+    path = _build()
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        path = _build(force=True)
+        try:
+            return ctypes.CDLL(path)
+        except OSError as e:
+            raise NativeUnavailable(
+                f"could not load native codec: {e}"
+            ) from e
+
+
 def get_lib():
     global _lib
     with _lock:
         if _lib is None:
-            path = _build()
-            lib = ctypes.CDLL(path)
+            lib = _load()
             lib.zk_parse_spans.restype = ctypes.c_int
             lib.zk_parse_spans.argtypes = [
                 ctypes.c_char_p, ctypes.c_int64,
@@ -242,9 +259,14 @@ def parse_spans_columnar(
         b.ann_ts[j] = ts
         b.ann_value_id[j] = dicts.annotations.encode(value)
         slen = int(cols["ann_svc_len"][j])
-        if slen >= 0:
-            soff = int(cols["ann_svc_off"][j])
-            svc_name = mem[soff:soff + slen].decode("utf-8", "replace")
+        if slen >= 0 or slen == -2:
+            if slen == -2:
+                # Endpoint present but service_name absent: same default
+                # as the python codec (wire/thrift.py _r_endpoint).
+                svc_name = "unknown"
+            else:
+                soff = int(cols["ann_svc_off"][j])
+                svc_name = mem[soff:soff + slen].decode("utf-8", "replace")
             svc_id = dicts.services.encode(svc_name.lower())
             b.ann_service_id[j] = svc_id
             b.ann_endpoint_id[j] = dicts.endpoints.encode(
@@ -288,9 +310,12 @@ def parse_spans_columnar(
             value = bytes(value)
         b.bann_value_id[j] = dicts.binary_values.encode(value)
         slen = int(cols["bann_svc_len"][j])
-        if slen >= 0:
-            soff = int(cols["bann_svc_off"][j])
-            svc_name = mem[soff:soff + slen].decode("utf-8", "replace")
+        if slen >= 0 or slen == -2:
+            if slen == -2:
+                svc_name = "unknown"
+            else:
+                soff = int(cols["bann_svc_off"][j])
+                svc_name = mem[soff:soff + slen].decode("utf-8", "replace")
             b.bann_service_id[j] = dicts.services.encode(svc_name.lower())
             b.bann_endpoint_id[j] = dicts.endpoints.encode(
                 (int(cols["bann_ipv4"][j]), int(cols["bann_port"][j]), svc_name)

@@ -38,8 +38,9 @@ def _summarize(state: dev.StoreState, axis: str) -> Dict[str, jnp.ndarray]:
     # HLL merge is an elementwise max.
     hll_regs = jax.lax.pmax(state.hll_traces, axis)
     # Moments combine is associative+commutative but not "+": gather the
-    # per-shard banks and tree-combine.
-    banks = jax.lax.all_gather(state.dep_moments, axis)  # [n, S*S, 5]
+    # per-shard banks (archive + live-ring join, see dev.total_dep_moments)
+    # and tree-combine.
+    banks = jax.lax.all_gather(dev.total_dep_moments(state), axis)  # [n, S*S, 5]
     dep_moments = M.reduce_moments(banks, axis=0)
     return {
         "spans_seen": spans_seen,
@@ -50,6 +51,23 @@ def _summarize(state: dev.StoreState, axis: str) -> Dict[str, jnp.ndarray]:
         "hll_traces": hll_regs,
         "dep_moments": dep_moments,
     }
+
+
+def make_sharded_archive(mesh: Mesh, axis: str = "shard"):
+    """Per-shard dependency-link archive step (dev.dep_archive_auto) so
+    links survive ring eviction in the sharded deployment exactly like
+    the single-store path; the watermark policy runs in-graph."""
+
+    def fn(state, incoming):
+        state = jax.tree.map(lambda x: x[0], state)
+        new_state = dev.dep_archive_auto(state, incoming)
+        return jax.tree.map(lambda x: x[None], new_state)
+
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
 
 
 def make_sharded_ingest(mesh: Mesh, axis: str = "shard"):
@@ -93,13 +111,32 @@ class ShardedStore:
         sharding = NamedSharding(mesh, P(axis))
         self.states = jax.device_put(_stack_states(config, self.n), sharding)
         self.step = make_sharded_ingest(mesh, axis)
+        self.archive_step = make_sharded_archive(mesh, axis)
         self.last_summary = None
+        # Host upper bound of any shard's write_pos / lower bound of any
+        # shard's archive watermark — gates the archive trigger without
+        # device syncs (mirrors TpuSpanStore._maybe_archive).
+        self._wp_upper = 0
+        self._archived_lower = 0
 
     def ingest(self, device_batches) -> Dict[str, np.ndarray]:
         """device_batches: pytree stacked [n_shards, ...]."""
+        incoming = int(np.max(np.asarray(device_batches.n_spans)))
+        self._maybe_archive(incoming)
         self.states, summary = self.step(self.states, device_batches)
+        self._wp_upper += incoming
         self.last_summary = summary
         return summary
+
+    def _maybe_archive(self, incoming: int) -> None:
+        cap = self.config.capacity
+        if self._wp_upper + incoming - self._archived_lower <= cap:
+            return
+        self.states = self.archive_step(self.states, jnp.int64(incoming))
+        self._archived_lower = min(
+            self._wp_upper,
+            max(self._wp_upper + incoming - cap, self._wp_upper - cap // 2),
+        )
 
 
 def global_summary(states, mesh: Mesh, axis: str = "shard"):

@@ -111,7 +111,16 @@ class StoreState:
     bann_write_pos: jnp.ndarray
 
     # -- streaming aggregate state (never evicted) ----------------------
-    dep_moments: jnp.ndarray  # [S*S, 5] f32 — exact DependencyLink moments
+    # Dependency links use an eviction-watermark archive: dep_moments
+    # holds links whose CHILD row gid < dep_archived_gid, folded in by
+    # dep_archive_step just before those rows near eviction (joined
+    # against the full resident ring, so parent/child halves arriving in
+    # different batches still link — ADVICE r1: a within-batch-only join
+    # systematically undercounts vs ZipkinAggregateJob). Links of newer
+    # children are computed on demand by live_dep_moments; the two are
+    # disjoint by construction, so total = combine(archive, live).
+    dep_moments: jnp.ndarray  # [S*S, 5] f32 — archived DependencyLink moments
+    dep_archived_gid: jnp.ndarray  # scalar i64 — archive watermark
     svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
     svc_span_counts: jnp.ndarray  # [S] f32
     ann_svc_counts: jnp.ndarray  # [S] f32 — services seen on any annotation
@@ -132,7 +141,8 @@ class StoreState:
         "ann_endpoint_id", "ann_write_pos",
         "bann_gid", "bann_key_id", "bann_value_id", "bann_type",
         "bann_service_id", "bann_endpoint_id", "bann_write_pos",
-        "dep_moments", "svc_hist", "svc_span_counts", "ann_svc_counts",
+        "dep_moments", "dep_archived_gid", "svc_hist", "svc_span_counts",
+        "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
         "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
     )
@@ -188,6 +198,7 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         # exact to 2.1e9 and psum-able. Only the Moments bank stays f32
         # (its combine adds batch-sized increments, not +1s).
         dep_moments=jnp.zeros((S * S, M.N_FIELDS), jnp.float32),
+        dep_archived_gid=jnp.int64(0),
         svc_hist=Q.init(
             shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
             dtype=jnp.int32,
@@ -356,7 +367,7 @@ def dep_link_moments(
 @jax.jit
 def recompute_dep_moments(state: "StoreState"):
     """Offline recompute over the live span ring (the rerunnable-batch-job
-    analogue; parity check for the streaming bank)."""
+    analogue; parity check for the streaming archive+live path)."""
     from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
 
     live = state.row_gid >= 0
@@ -365,6 +376,75 @@ def recompute_dep_moments(state: "StoreState"):
         state.trace_id, state.span_id, state.parent_id, state.service_id,
         state.duration, live, live & has_parent, state.config.max_services,
     )
+
+
+def _ring_children(state: "StoreState"):
+    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
+
+    live = state.row_gid >= 0
+    has_parent = (state.flags & jnp.int32(int(FLAG_HAS_PARENT))) != 0
+    return live, live & has_parent
+
+
+@jax.jit
+def dep_archive_step(state: "StoreState", w_new) -> "StoreState":
+    """Fold links of children with archived_gid <= gid < ``w_new`` into
+    the archive bank and advance the watermark.
+
+    Children join against the FULL resident ring, so parent and child
+    halves that arrived in different payloads (the normal case across
+    services) still produce their link — the streaming equivalent of
+    ZipkinAggregateJob.scala:26-38 run over a sliding window. Callers
+    (TpuSpanStore._maybe_archive) invoke this before unarchived rows can
+    be evicted, so every child is joined exactly once.
+    """
+    w_new = jnp.asarray(w_new, jnp.int64)
+    live, children = _ring_children(state)
+    probe = (
+        children
+        & (state.row_gid >= state.dep_archived_gid)
+        & (state.row_gid < w_new)
+    )
+    bank = dep_link_moments(
+        state.trace_id, state.span_id, state.parent_id, state.service_id,
+        state.duration, live, probe, state.config.max_services,
+    )
+    return state.replace(
+        dep_moments=M.combine(state.dep_moments, bank),
+        dep_archived_gid=jnp.maximum(state.dep_archived_gid, w_new),
+    )
+
+
+@jax.jit
+def dep_archive_auto(state: "StoreState", incoming) -> "StoreState":
+    """dep_archive_step with the watermark policy computed in-graph:
+    archive everything an ``incoming``-span write could evict, keeping
+    at most the freshest half-capacity unarchived so late-arriving
+    parents can still link. Usable under shard_map (no host mirrors)."""
+    cap = state.config.capacity
+    wp = state.write_pos
+    w_new = jnp.maximum(wp + jnp.asarray(incoming, jnp.int64) - cap,
+                        wp - cap // 2)
+    w_new = jnp.minimum(jnp.maximum(w_new, state.dep_archived_gid), wp)
+    return dep_archive_step(state, w_new)
+
+
+@jax.jit
+def live_dep_moments(state: "StoreState"):
+    """Links whose child is live and not yet archived (gid >= watermark).
+    Disjoint from the archive bank; total links = combine of the two."""
+    live, children = _ring_children(state)
+    probe = children & (state.row_gid >= state.dep_archived_gid)
+    return dep_link_moments(
+        state.trace_id, state.span_id, state.parent_id, state.service_id,
+        state.duration, live, probe, state.config.max_services,
+    )
+
+
+@jax.jit
+def total_dep_moments(state: "StoreState"):
+    """Archive + live: the complete dependency-link Moments bank."""
+    return M.combine(state.dep_moments, live_dep_moments(state))
 
 
 # ---------------------------------------------------------------------------
@@ -424,14 +504,9 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         upd[col] = getattr(state, col).at[bb_widx].set(getattr(b, col), mode="drop")
     upd["bann_write_pos"] = state.bann_write_pos + b.n_banns.astype(jnp.int64)
 
-    # -- dependency links: within-batch parent join --------------------
-    # (trace_id, parent_id) probe against (trace_id, span_id) build —
-    # the streaming form of ZipkinAggregateJob.scala:26-38.
-    batch_moments = dep_link_moments(
-        b.trace_id, b.span_id, b.parent_id, b.service_id, b.duration,
-        mask, mask & b.has_parent, S,
-    )
-    upd["dep_moments"] = M.combine(state.dep_moments, batch_moments)
+    # Dependency links are NOT joined here: the within-batch join missed
+    # parent/child halves split across payloads. See dep_archive_step /
+    # live_dep_moments — the join always runs against the resident ring.
 
     # -- per-service latency histogram ---------------------------------
     hist = svc_histogram(state)

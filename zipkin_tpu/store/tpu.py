@@ -33,6 +33,7 @@ from zipkin_tpu.ops import hll
 from zipkin_tpu.ops import quantile as Q
 from zipkin_tpu.store import device as dev
 from zipkin_tpu.columnar.encode import to_signed64
+from zipkin_tpu.concurrency import RWLock
 from zipkin_tpu.store.base import (
     IndexedTraceId,
     SpanStore,
@@ -57,7 +58,19 @@ class TpuSpanStore(SpanStore):
         self.config = config or dev.StoreConfig()
         self.codec = codec or SpanCodec()
         self.state = dev.init_state(self.config)
+        # Serializes writers against each other (queue workers).
         self._lock = threading.Lock()
+        # Guards the state swap: ingest_step donates the old state's
+        # device buffers, so queries snapshot self.state under a read
+        # lock and hold it across their kernels + host gathers, while
+        # the donating step runs under the write lock (ADVICE r1 high).
+        self._rw = RWLock()
+        # Host mirrors of write_pos / dep_archived_gid, driving the
+        # dependency-archive policy without a device sync per batch.
+        self._wp = 0
+        self._archived = 0
+        # Keyed by to_signed64(trace_id) — ids >= 2^63 arrive unsigned
+        # on some write paths and signed on others.
         self.ttls: Dict[int, float] = {}
         # name_id -> lowercased-name id, maintained incrementally.
         self._name_lc: Dict[int, int] = {}
@@ -93,11 +106,12 @@ class TpuSpanStore(SpanStore):
             return
         with self._lock:
             for span in spans:
-                self.ttls[span.trace_id] = 1.0
+                self.ttls.setdefault(to_signed64(span.trace_id), 1.0)
             self._prune_ttls()
-            # Chunk on whole-trace boundaries: the streaming dependency
-            # join is within-batch, so splitting a trace across chunks
-            # would silently drop its parent→child links.
+            # Chunking keeps jit shapes bounded and batches under ring
+            # capacity (a single launch must not scatter colliding
+            # slots); trace grouping just keeps each trace's rows
+            # adjacent in the ring.
             for part in self._chunk_by_trace(spans):
                 batch = self.codec.encode(part)
                 indexable = np.fromiter(
@@ -116,8 +130,8 @@ class TpuSpanStore(SpanStore):
                 yield batch
                 batch = []
             batch.extend(trace_spans)
-            # A single trace larger than the chunk is split (its
-            # cross-chunk links fall to the offline recompute path).
+            # A single trace larger than the chunk is split; its links
+            # still join via the resident-ring archive path.
             while len(batch) > chunk_size:
                 yield batch[:chunk_size]
                 batch = batch[chunk_size:]
@@ -151,17 +165,77 @@ class TpuSpanStore(SpanStore):
             if batch.n_spans == 0:
                 return 0
             for tid in np.unique(batch.trace_id):
-                self.ttls[int(tid)] = 1.0
+                self.ttls.setdefault(int(tid), 1.0)
             self._prune_ttls()
             indexable = native.indexable_from_batch(batch, self.dicts)
-            db = dev.make_device_batch(
-                batch, name_lc_id=name_lc, indexable=indexable,
-                pad_spans=_next_pow2(batch.n_spans),
-                pad_anns=_next_pow2(batch.n_annotations),
-                pad_banns=_next_pow2(batch.n_binary),
-            )
-            self.state = dev.ingest_step(self.state, db)
+            for part, part_lc, part_ix in self._chunk_columnar(
+                batch, name_lc, indexable
+            ):
+                db = dev.make_device_batch(
+                    part, name_lc_id=part_lc, indexable=part_ix,
+                    pad_spans=_next_pow2(part.n_spans),
+                    pad_anns=_next_pow2(part.n_annotations),
+                    pad_banns=_next_pow2(part.n_binary),
+                )
+                self._maybe_archive(int(part.n_spans))
+                with self._rw.write():
+                    self.state = dev.ingest_step(self.state, db)
+                self._wp += int(part.n_spans)
             return batch.n_spans
+
+    def _chunk_columnar(self, batch: SpanBatch, name_lc: np.ndarray,
+                        indexable: np.ndarray):
+        """Split a parsed columnar batch so every chunk fits the ring
+        capacities (a single launch must never scatter colliding slots —
+        see write_batch). The common case (batch fits) costs nothing."""
+        c = self.config
+        max_spans = min(self.MAX_CHUNK, c.capacity // 2 or 1)
+        if (batch.n_spans <= max_spans
+                and batch.n_annotations <= c.ann_capacity
+                and batch.n_binary <= c.bann_capacity):
+            yield batch, name_lc, indexable
+            return
+        start = 0
+        while start < batch.n_spans:
+            stop = min(start + max_spans, batch.n_spans)
+            # Shrink until the chunk's annotation rows fit their rings.
+            while stop > start + 1:
+                a_n = int(np.count_nonzero(
+                    (batch.ann_span_idx >= start) & (batch.ann_span_idx < stop)
+                ))
+                b_n = int(np.count_nonzero(
+                    (batch.bann_span_idx >= start)
+                    & (batch.bann_span_idx < stop)
+                ))
+                if a_n <= c.ann_capacity and b_n <= c.bann_capacity:
+                    break
+                stop = start + (stop - start) // 2
+            yield (self._slice_batch(batch, start, stop),
+                   name_lc[start:stop], indexable[start:stop])
+            start = stop
+
+    @staticmethod
+    def _slice_batch(batch: SpanBatch, start: int, stop: int) -> SpanBatch:
+        """Columnar slice of span rows [start, stop) with their
+        annotation/binary rows, span indices rebased."""
+        a_sel = (batch.ann_span_idx >= start) & (batch.ann_span_idx < stop)
+        b_sel = (batch.bann_span_idx >= start) & (batch.bann_span_idx < stop)
+        out = SpanBatch.empty(
+            stop - start, int(a_sel.sum()), int(b_sel.sum())
+        )
+        for col in ("trace_id", "span_id", "parent_id", "name_id",
+                    "service_id", "ts_cs", "ts_cr", "ts_sr", "ts_ss",
+                    "ts_first", "ts_last", "duration", "flags"):
+            setattr(out, col, getattr(batch, col)[start:stop])
+        out.ann_span_idx = batch.ann_span_idx[a_sel] - start
+        for col in ("ann_ts", "ann_value_id", "ann_service_id",
+                    "ann_endpoint_id"):
+            setattr(out, col, getattr(batch, col)[a_sel])
+        out.bann_span_idx = batch.bann_span_idx[b_sel] - start
+        for col in ("bann_key_id", "bann_value_id", "bann_type",
+                    "bann_service_id", "bann_endpoint_id"):
+            setattr(out, col, getattr(batch, col)[b_sel])
+        return out
 
     def write_batch(self, batch: SpanBatch, indexable: np.ndarray) -> None:
         """Upload one columnar batch and run the fused ingest step.
@@ -188,15 +262,33 @@ class TpuSpanStore(SpanStore):
             pad_anns=_next_pow2(batch.n_annotations),
             pad_banns=_next_pow2(batch.n_binary),
         )
-        self.state = dev.ingest_step(self.state, db)
+        self._maybe_archive(batch.n_spans)
+        with self._rw.write():
+            self.state = dev.ingest_step(self.state, db)
+        self._wp += batch.n_spans
+
+    def _maybe_archive(self, incoming: int) -> None:
+        """Archive dependency links of ring rows an upcoming write could
+        evict (see dev.dep_archive_step). The watermark policy runs
+        in-graph (dep_archive_auto); the host mirrors only gate the
+        trigger, amortizing the full-ring join to one pass per
+        half-capacity of ingested spans."""
+        cap = self.config.capacity
+        if self._wp + incoming - self._archived <= cap:
+            return
+        with self._rw.write():
+            self.state = dev.dep_archive_auto(self.state, incoming)
+        self._archived = min(
+            self._wp, max(self._wp + incoming - cap, self._wp - cap // 2)
+        )
 
     def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
         with self._lock:
-            self.ttls[trace_id] = ttl_seconds
+            self.ttls[to_signed64(trace_id)] = ttl_seconds
 
     def get_time_to_live(self, trace_id: int) -> float:
         with self._lock:
-            return self.ttls[trace_id]
+            return self.ttls[to_signed64(trace_id)]
 
     # -- id lookups -----------------------------------------------------
 
@@ -216,12 +308,14 @@ class TpuSpanStore(SpanStore):
                 return []
         else:
             name_lc = -1
-        tids, tss, ok = dev.query_trace_ids_by_service(
-            self.state, svc, name_lc, end_ts, limit
-        )
+        with self._rw.read():
+            tids, tss, ok = dev.query_trace_ids_by_service(
+                self.state, svc, name_lc, end_ts, limit
+            )
+            tids, tss, ok = np.asarray(tids), np.asarray(tss), np.asarray(ok)
         return [
             IndexedTraceId(int(t), int(ts))
-            for t, ts, v in zip(np.asarray(tids), np.asarray(tss), np.asarray(ok))
+            for t, ts, v in zip(tids, tss, ok)
             if v
         ]
 
@@ -259,13 +353,15 @@ class TpuSpanStore(SpanStore):
             bann_value = bann_value2 = -1
             if ann_value < 0 and bann_key < 0:
                 return []
-        tids, tss, ok = dev.query_trace_ids_by_annotation(
-            self.state, svc, ann_value, bann_key, bann_value, bann_value2,
-            end_ts, limit,
-        )
+        with self._rw.read():
+            tids, tss, ok = dev.query_trace_ids_by_annotation(
+                self.state, svc, ann_value, bann_key, bann_value, bann_value2,
+                end_ts, limit,
+            )
+            tids, tss, ok = np.asarray(tids), np.asarray(tss), np.asarray(ok)
         return [
             IndexedTraceId(int(t), int(ts))
-            for t, ts, v in zip(np.asarray(tids), np.asarray(tss), np.asarray(ok))
+            for t, ts, v in zip(tids, tss, ok)
             if v
         ]
 
@@ -287,8 +383,10 @@ class TpuSpanStore(SpanStore):
             return set()
         canon = self._canon_ids(trace_ids)
         qids = self._sorted_qids(trace_ids)
-        span_in, _, _ = dev.query_trace_membership(self.state, qids)
-        present_tids = np.asarray(self.state.trace_id)[np.asarray(span_in)]
+        with self._rw.read():
+            st = self.state
+            span_in, _, _ = dev.query_trace_membership(st, qids)
+            present_tids = np.asarray(st.trace_id)[np.asarray(span_in)]
         return {
             canon[t] for t in np.unique(present_tids).tolist() if t in canon
         }
@@ -297,10 +395,13 @@ class TpuSpanStore(SpanStore):
         if not trace_ids:
             return []
         qids = self._sorted_qids(trace_ids)
-        span_in, ann_in, bann_in = dev.query_trace_membership(self.state, qids)
-        rows, spans = self._materialize(
-            np.asarray(span_in), np.asarray(ann_in), np.asarray(bann_in)
-        )
+        with self._rw.read():
+            st = self.state
+            span_in, ann_in, bann_in = dev.query_trace_membership(st, qids)
+            rows, spans = self._materialize(
+                st,
+                np.asarray(span_in), np.asarray(ann_in), np.asarray(bann_in),
+            )
         by_tid: Dict[int, List[Span]] = {}
         for row, span in zip(rows, spans):
             by_tid.setdefault(span.trace_id, []).append(span)
@@ -313,11 +414,12 @@ class TpuSpanStore(SpanStore):
         ]
 
     def _materialize(
-        self, span_mask: np.ndarray, ann_mask: np.ndarray, bann_mask: np.ndarray
+        self, st, span_mask: np.ndarray, ann_mask: np.ndarray,
+        bann_mask: np.ndarray,
     ) -> Tuple[np.ndarray, List[Span]]:
-        """Gather masked ring rows to host and decode to Span objects,
-        ordered by insertion (global row id)."""
-        st = self.state
+        """Gather masked ring rows of snapshot ``st`` to host and decode
+        to Span objects, ordered by insertion (global row id). Callers
+        hold the read lock for the lifetime of ``st``."""
         rows = np.flatnonzero(span_mask)
         if rows.size == 0:
             return rows, []
@@ -381,10 +483,11 @@ class TpuSpanStore(SpanStore):
             return []
         canon = self._canon_ids(trace_ids)
         qids = self._sorted_qids(trace_ids)
-        found, min_first, max_last = dev.query_durations(self.state, qids)
-        found = np.asarray(found)
-        min_first = np.asarray(min_first)
-        max_last = np.asarray(max_last)
+        with self._rw.read():
+            found, min_first, max_last = dev.query_durations(self.state, qids)
+            found = np.asarray(found)
+            min_first = np.asarray(min_first)
+            max_last = np.asarray(max_last)
         by_tid = {
             canon[int(q)]: TraceIdDuration(canon[int(q)], int(mx - mn), int(mn))
             for q, f, mn, mx in zip(qids, found, min_first, max_last)
@@ -395,7 +498,8 @@ class TpuSpanStore(SpanStore):
     # -- name catalogs --------------------------------------------------
 
     def get_all_service_names(self) -> Set[str]:
-        present = np.asarray(self.state.ann_svc_counts) > 0
+        with self._rw.read():
+            present = np.asarray(self.state.ann_svc_counts) > 0
         d = self.dicts.services
         return {
             d.decode(i) for i in np.flatnonzero(present)
@@ -406,7 +510,8 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service)
         if svc is None:
             return set()
-        row = np.asarray(self.state.name_presence[svc]) > 0
+        with self._rw.read():
+            row = np.asarray(self.state.name_presence[svc]) > 0
         d = self.dicts.span_names
         return {
             d.decode(i) for i in np.flatnonzero(row)
@@ -416,14 +521,19 @@ class TpuSpanStore(SpanStore):
     # -- analytics (the reference's offline aggregates, served live) ----
 
     def get_dependencies(self) -> Dependencies:
-        """DependencyLinks from the streaming Moments bank — the live
-        equivalent of Aggregates.getDependencies (Aggregates.scala:31)."""
+        """DependencyLinks from the archive bank + a live-ring join — the
+        live equivalent of Aggregates.getDependencies (Aggregates.scala:31).
+        Cross-batch parent/child pairs link because the join always runs
+        against the resident ring (dev.dep_archive_step docstring)."""
         from zipkin_tpu.aggregate.job import dependencies_from_bank
 
+        with self._rw.read():
+            st = self.state
+            bank = np.asarray(dev.total_dep_moments(st))
+            ts_min, ts_max = float(st.ts_min), float(st.ts_max)
         return dependencies_from_bank(
-            self.state.dep_moments, self.dicts.services,
-            self.config.max_services,
-            float(self.state.ts_min), float(self.state.ts_max),
+            bank, self.dicts.services, self.config.max_services,
+            ts_min, ts_max,
         )
 
     def service_duration_quantiles(
@@ -432,15 +542,18 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service)
         if svc is None:
             return None
-        hist = dev.svc_histogram(self.state)
-        one = Q.LogHistogram(hist.counts[svc], hist.gamma, hist.min_value)
+        with self._rw.read():
+            hist = dev.svc_histogram(self.state)
+            counts = np.asarray(hist.counts[svc])
+        one = Q.LogHistogram(counts, hist.gamma, hist.min_value)
         return [float(Q.quantile(one, q)) for q in qs]
 
     def top_annotations(self, service: str, k: int = 10) -> List[Tuple[str, int]]:
         svc = self._svc_id(service)
         if svc is None:
             return []
-        row = np.asarray(self.state.ann_value_counts[svc])
+        with self._rw.read():
+            row = np.asarray(self.state.ann_value_counts[svc])
         order = np.argsort(-row)[:k]
         d = self.dicts.annotations
         return [
@@ -453,7 +566,8 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service)
         if svc is None:
             return []
-        row = np.asarray(self.state.bann_key_counts[svc])
+        with self._rw.read():
+            row = np.asarray(self.state.bann_key_counts[svc])
         order = np.argsort(-row)[:k]
         d = self.dicts.binary_keys
         return [
@@ -462,7 +576,10 @@ class TpuSpanStore(SpanStore):
         ]
 
     def estimated_unique_traces(self) -> float:
-        return float(hll.estimate(hll.HyperLogLog(self.state.hll_traces)))
+        with self._rw.read():
+            regs = np.asarray(self.state.hll_traces)
+        return float(hll.estimate(hll.HyperLogLog(regs)))
 
     def counters(self) -> Dict[str, float]:
-        return {k: float(v) for k, v in self.state.counters.items()}
+        with self._rw.read():
+            return {k: float(v) for k, v in self.state.counters.items()}

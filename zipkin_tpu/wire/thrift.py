@@ -185,7 +185,14 @@ class _Reader:
             raise ThriftError("negative string length")
         return self.take(n)
 
-    def skip(self, ftype: int) -> None:
+    # Depth-bounded: crafted deeply nested containers on the
+    # network-facing ingest path must fail the parse (ThriftError), not
+    # exhaust the interpreter stack. Mirrors the native parser's bound.
+    MAX_SKIP_DEPTH = 64
+
+    def skip(self, ftype: int, depth: int = 0) -> None:
+        if depth > self.MAX_SKIP_DEPTH:
+            raise ThriftError("thrift container nesting too deep")
         if ftype == T_BOOL or ftype == T_BYTE:
             self.take(1)
         elif ftype == T_I16:
@@ -202,16 +209,16 @@ class _Reader:
                 if ft == T_STOP:
                     break
                 self.i16()
-                self.skip(ft)
+                self.skip(ft, depth + 1)
         elif ftype in (T_LIST, T_SET):
             et = self.u8()
             for _ in range(self.i32()):
-                self.skip(et)
+                self.skip(et, depth + 1)
         elif ftype == T_MAP:
             kt, vt = self.u8(), self.u8()
             for _ in range(self.i32()):
-                self.skip(kt)
-                self.skip(vt)
+                self.skip(kt, depth + 1)
+                self.skip(vt, depth + 1)
         else:
             raise ThriftError(f"unknown thrift type {ftype}")
 
