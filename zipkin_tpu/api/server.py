@@ -51,8 +51,13 @@ class ApiServer:
         self.query = query
         self.collector = collector
         self.pin_ttl_s = pin_ttl_s
+        # Scribe rides the columnar fast path (raw thrift bytes →
+        # native parse on a collector worker); the collector falls back
+        # to the python codec when the native library is unavailable.
         self.scribe = (
-            ScribeReceiver(collector.accept) if collector is not None else None
+            ScribeReceiver(collector.accept,
+                           process_thrift=collector.accept_thrift)
+            if collector is not None else None
         )
         self.json_ingest = (
             JsonReceiver(collector.accept) if collector is not None else None

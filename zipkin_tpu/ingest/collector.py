@@ -24,6 +24,19 @@ from zipkin_tpu.sampler.core import Sampler
 from zipkin_tpu.store.base import WriteSpanStore
 
 
+class _ThriftPayload:
+    """Queue item marking raw thrift bytes for the columnar fast path.
+
+    ``segments`` keeps transport-level message boundaries (one scribe
+    LogEntry / kafka message each) so a corrupt segment can be isolated
+    instead of poisoning the whole batch."""
+
+    __slots__ = ("segments",)
+
+    def __init__(self, segments: Sequence[bytes]):
+        self.segments = list(segments)
+
+
 class Collector:
     def __init__(
         self,
@@ -45,6 +58,10 @@ class Collector:
         self._last_tick_s: Optional[float] = None
         self.spans_dropped = 0
         self.spans_stored = 0
+        self.bad_payloads = 0
+        # The fast path needs both the native parser and a store that
+        # accepts raw thrift (TpuSpanStore.write_thrift); probed once.
+        self._fast_ok: Optional[bool] = None
 
     # -- pipeline -------------------------------------------------------
 
@@ -52,12 +69,81 @@ class Collector:
         """Receiver-facing entry; raises QueueFullException when full."""
         self.queue.add(list(spans))
 
-    def _write(self, spans) -> None:
+    def accept_thrift(self, payload) -> None:
+        """Raw thrift Span-sequence entry (scribe/kafka fast path): the
+        payload — one bytes blob or a sequence of per-message segments —
+        decodes on a worker via the native columnar parser when
+        available (ScribeSpanReceiver.scala:96-107's scrooge hot decode),
+        falling back to the python codec. Sampling is applied either
+        way. Raises QueueFullException when full."""
+        segments = [payload] if isinstance(payload, (bytes, bytearray)) \
+            else list(payload)
+        self.queue.add(_ThriftPayload(segments))
+
+    def _fast_path_available(self) -> bool:
+        if self._fast_ok is None:
+            if getattr(self.store, "write_thrift", None) is None:
+                self._fast_ok = False
+            else:
+                from zipkin_tpu import native
+
+                self._fast_ok = native.available()
+        return self._fast_ok
+
+    def _write(self, item) -> None:
+        if isinstance(item, _ThriftPayload):
+            self._write_thrift(item.segments)
+            return
+        spans = item
         kept = [s for s in spans if s.debug or self.sampler(s.trace_id)]
         self.spans_dropped += len(spans) - len(kept)
         if kept:
             self.store.apply(kept)
             self.spans_stored += len(kept)
+
+    def _write_thrift(self, segments) -> None:
+        if not self._fast_path_available():
+            self._decode_segments_slow(segments)
+            return
+        from zipkin_tpu.native import ParseCapacityError
+
+        try:
+            written, dropped, written_debug = self.store.write_thrift(
+                b"".join(segments), sample_threshold=self.sampler.threshold
+            )
+        except ParseCapacityError:
+            # Valid but oversized: halve and retry (single segments that
+            # still don't fit go through the chunking python path).
+            if len(segments) > 1:
+                mid = len(segments) // 2
+                self._write_thrift(segments[:mid])
+                self._write_thrift(segments[mid:])
+            else:
+                self._decode_segments_slow(segments)
+            return
+        except ValueError:
+            # A corrupt segment poisons the concatenated parse; isolate
+            # it by decoding per segment (slow-path semantics: skip bad,
+            # keep good — ScribeReceiver's per-entry 'bad' accounting).
+            self._decode_segments_slow(segments)
+            return
+        # Slow-path counter parity: debug spans never hit the sampler.
+        self.sampler.allowed += written - written_debug
+        self.sampler.denied += dropped
+        self.spans_stored += written
+        self.spans_dropped += dropped
+
+    def _decode_segments_slow(self, segments) -> None:
+        from zipkin_tpu.wire.thrift import ThriftError, spans_from_bytes
+
+        spans = []
+        for seg in segments:
+            try:
+                spans.extend(spans_from_bytes(seg))
+            except ThriftError:
+                self.bad_payloads += 1
+        if spans:
+            self._write(spans)
 
     # -- control loop (call periodically, e.g. every 30s) ---------------
 

@@ -36,8 +36,10 @@ class KafkaSpanReceiver:
         streams: Sequence[Iterable[bytes]],
         retry_backoff_s: float = 0.05,
         max_retries: int = 100,
+        process_thrift: Optional[Callable[[bytes], None]] = None,
     ):
         self.process = process
+        self.process_thrift = process_thrift
         self.streams = streams
         self.retry_backoff_s = retry_backoff_s
         self.max_retries = max_retries
@@ -47,6 +49,14 @@ class KafkaSpanReceiver:
     def _drain(self, stream: Iterable[bytes]) -> None:
         for message in stream:
             self.stats["messages"] += 1
+            if not message:
+                continue
+            if self.process_thrift is not None:
+                # Fast path: raw bytes straight to the collector; the
+                # columnar parse happens on its worker (malformed
+                # payloads count there as bad_payloads).
+                self._offer(self.process_thrift, message)
+                continue
             try:
                 spans = spans_from_bytes(message)
             except ThriftError:
@@ -54,16 +64,19 @@ class KafkaSpanReceiver:
                 continue
             if not spans:
                 continue
-            for attempt in range(self.max_retries + 1):
-                try:
-                    self.process(spans)
+            self._offer(self.process, spans)
+
+    def _offer(self, fn, item) -> None:
+        for attempt in range(self.max_retries + 1):
+            try:
+                fn(item)
+                break
+            except QueueFullException:
+                if attempt == self.max_retries:
+                    self.stats["dropped"] += 1
                     break
-                except QueueFullException:
-                    if attempt == self.max_retries:
-                        self.stats["dropped"] += 1
-                        break
-                    self.stats["retries"] += 1
-                    time.sleep(self.retry_backoff_s)
+                self.stats["retries"] += 1
+                time.sleep(self.retry_backoff_s)
 
     def run(self) -> None:
         """Drain every stream to exhaustion on worker threads and join

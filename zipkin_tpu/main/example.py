@@ -21,6 +21,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9411)
+    p.add_argument("--scribe-port", type=int, default=9410,
+                   help="framed-thrift Scribe.Log TCP port (0 disables)")
     p.add_argument("--memory-store", action="store_true",
                    help="use the in-memory reference store instead of TPU")
     p.add_argument("--capacity", type=int, default=1 << 16,
@@ -94,7 +96,19 @@ def main(argv=None) -> None:
 
     server = make_server(api, args.host, args.port)
     serve_forever_in_thread(server)
-    print(f"zipkin-tpu example serving on {args.host}:{args.port}")
+    scribe_srv = None
+    if args.scribe_port:
+        from zipkin_tpu.ingest.receiver import ScribeReceiver
+        from zipkin_tpu.ingest.scribe_server import ScribeServer
+
+        scribe_srv = ScribeServer(
+            ScribeReceiver(collector.accept,
+                           process_thrift=collector.accept_thrift),
+            args.host, args.scribe_port,
+        )
+        scribe_srv.serve_in_thread()
+    print(f"zipkin-tpu example serving on {args.host}:{args.port}"
+          + (f" (scribe tcp :{args.scribe_port})" if scribe_srv else ""))
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -117,6 +131,8 @@ def main(argv=None) -> None:
                 last_ckpt = time.time()
     finally:
         checkpoint_now()
+        if scribe_srv is not None:
+            scribe_srv.shutdown()
         server.shutdown()
         collector.close()
 

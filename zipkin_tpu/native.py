@@ -158,6 +158,11 @@ def indexable_from_batch(batch: SpanBatch, dicts: DictionarySet) -> np.ndarray:
     return out
 
 
+class ParseCapacityError(ValueError):
+    """Valid payload larger than the parse buffers — chunk and retry
+    (distinct from malformed input so callers don't drop good data)."""
+
+
 def parse_spans_columnar(
     payload: bytes, dicts: DictionarySet,
     max_spans: int = 1 << 16,
@@ -166,7 +171,27 @@ def parse_spans_columnar(
 
     The numeric work happens in C++; this wrapper interns strings and
     assembles the SpanBatch. Raises NativeUnavailable when the shared
-    object can't be built; ValueError on malformed input.
+    object can't be built; ValueError on malformed input;
+    ParseCapacityError when the payload exceeds the parse buffers.
+    """
+    batch, name_lc, _, _ = parse_spans_columnar_sampled(
+        payload, dicts, 0, max_spans
+    )
+    return batch, name_lc
+
+
+def parse_spans_columnar_sampled(
+    payload: bytes, dicts: DictionarySet,
+    sample_threshold: int, max_spans: int = 1 << 16,
+) -> Tuple[SpanBatch, np.ndarray, int, int]:
+    """parse_spans_columnar with the sampler's trace-id threshold test
+    applied on the numeric columns BEFORE any string interning, so
+    sampled-out traffic never pollutes the dictionaries (or pays intern
+    cost). Debug-flagged spans always pass (SpanSamplerFilter.scala:40).
+
+    Returns (batch, name_lc, n_dropped, n_kept_debug) where
+    n_kept_debug counts kept spans carrying the debug flag (the slow
+    path never runs those through the sampler's counters).
     """
     lib = get_lib()
     max_anns = max_spans * 8
@@ -217,47 +242,64 @@ def parse_spans_columnar(
     if rc == -1:
         raise ValueError("malformed thrift span payload")
     if rc in (-2, -3, -4):
-        raise ValueError("payload exceeds parse capacity; chunk the input")
+        raise ParseCapacityError(
+            "payload exceeds parse capacity; chunk the input"
+        )
     ns, na, nb = n_spans.value, n_anns.value, n_banns.value
 
-    b = SpanBatch.empty(ns, na, nb)
-    b.trace_id[:] = cols["trace_id"][:ns]
-    b.span_id[:] = cols["span_id"][:ns]
-    b.parent_id[:] = cols["parent_id"][:ns]
+    # Sampler threshold test on the numeric columns, pre-intern.
+    debug_col = cols["debug"][:ns] != 0
+    if sample_threshold > 0 and ns:
+        tids = cols["trace_id"][:ns]
+        t = np.where(tids == np.int64(-(2**63)), np.int64(2**63 - 1),
+                     np.abs(tids))
+        keep = debug_col | (t > np.int64(sample_threshold))
+    else:
+        keep = np.ones(ns, bool)
+    kept_idx = np.flatnonzero(keep)
+    dropped = int(ns - kept_idx.size)
+    kept_debug = int(np.count_nonzero(debug_col & keep))
+    new_of_old = np.cumsum(keep) - 1  # old span index → new
+    ka = (keep[cols["ann_span_idx"][:na]] if na
+          else np.zeros(0, bool))
+    kb = (keep[cols["bann_span_idx"][:nb]] if nb
+          else np.zeros(0, bool))
+    kns = kept_idx.size
+
+    b = SpanBatch.empty(kns, int(np.count_nonzero(ka)),
+                        int(np.count_nonzero(kb)))
+    b.trace_id[:] = cols["trace_id"][:ns][keep]
+    b.span_id[:] = cols["span_id"][:ns][keep]
+    b.parent_id[:] = cols["parent_id"][:ns][keep]
     b.flags[:] = (
-        cols["has_parent"][:ns] * np.uint8(FLAG_HAS_PARENT)
-        + cols["debug"][:ns] * np.uint8(FLAG_DEBUG)
+        cols["has_parent"][:ns][keep] * np.uint8(FLAG_HAS_PARENT)
+        + cols["debug"][:ns][keep] * np.uint8(FLAG_DEBUG)
     )
 
     mem = payload  # bytes: slicing is cheap
 
-    def intern(off, length, dictionary, decode_utf8=True):
-        raw = mem[off:off + length]
-        return dictionary.encode(
-            raw.decode("utf-8", "replace") if decode_utf8 else raw
-        )
-
-    name_lc = np.empty(ns, np.int32)
-    for i in range(ns):
+    name_lc = np.empty(kns, np.int32)
+    for out_i, i in enumerate(kept_idx):
         raw = mem[int(cols["name_off"][i]):
                   int(cols["name_off"][i]) + int(cols["name_len"][i])]
         name = raw.decode("utf-8", "replace")
-        b.name_id[i] = dicts.span_names.encode(name)
-        name_lc[i] = (
+        b.name_id[out_i] = dicts.span_names.encode(name)
+        name_lc[out_i] = (
             -1 if name == "" else dicts.span_names.encode(name.lower())
         )
 
     # Annotation table + per-span core-ts columns and owning service.
-    server_svc = np.full(ns, NO_SERVICE, np.int64)
-    client_svc = np.full(ns, NO_SERVICE, np.int64)
-    for j in range(na):
-        si = int(cols["ann_span_idx"][j])
+    server_svc = np.full(kns, NO_SERVICE, np.int64)
+    client_svc = np.full(kns, NO_SERVICE, np.int64)
+    aj = 0
+    for j in np.flatnonzero(ka):
+        si = int(new_of_old[cols["ann_span_idx"][j]])
         ts = int(cols["ann_ts"][j])
         voff, vlen = int(cols["ann_value_off"][j]), int(cols["ann_value_len"][j])
         value = mem[voff:voff + vlen].decode("utf-8", "replace")
-        b.ann_span_idx[j] = si
-        b.ann_ts[j] = ts
-        b.ann_value_id[j] = dicts.annotations.encode(value)
+        b.ann_span_idx[aj] = si
+        b.ann_ts[aj] = ts
+        b.ann_value_id[aj] = dicts.annotations.encode(value)
         slen = int(cols["ann_svc_len"][j])
         if slen >= 0 or slen == -2:
             if slen == -2:
@@ -268,8 +310,8 @@ def parse_spans_columnar(
                 soff = int(cols["ann_svc_off"][j])
                 svc_name = mem[soff:soff + slen].decode("utf-8", "replace")
             svc_id = dicts.services.encode(svc_name.lower())
-            b.ann_service_id[j] = svc_id
-            b.ann_endpoint_id[j] = dicts.endpoints.encode(
+            b.ann_service_id[aj] = svc_id
+            b.ann_endpoint_id[aj] = dicts.endpoints.encode(
                 (int(cols["ann_ipv4"][j]), int(cols["ann_port"][j]), svc_name)
             )
             if value in (SERVER_RECV, SERVER_SEND) and server_svc[si] < 0:
@@ -283,6 +325,7 @@ def parse_spans_columnar(
             b.ts_first[si] = ts
         if b.ts_last[si] == NO_TS or ts > b.ts_last[si]:
             b.ts_last[si] = ts
+        aj += 1
 
     has_ts = b.ts_first != NO_TS
     b.duration[has_ts] = b.ts_last[has_ts] - b.ts_first[has_ts]
@@ -291,24 +334,26 @@ def parse_spans_columnar(
         np.where(client_svc >= 0, client_svc, NO_SERVICE),
     ).astype(np.int32)
 
-    for j in range(nb):
-        b.bann_span_idx[j] = int(cols["bann_span_idx"][j])
+    from zipkin_tpu.models.span import AnnotationType
+    from zipkin_tpu.wire.thrift import _decode_binary_value
+
+    bj = 0
+    for j in np.flatnonzero(kb):
+        b.bann_span_idx[bj] = int(new_of_old[cols["bann_span_idx"][j]])
         koff, klen = int(cols["bann_key_off"][j]), int(cols["bann_key_len"][j])
-        b.bann_key_id[j] = dicts.binary_keys.encode(
+        b.bann_key_id[bj] = dicts.binary_keys.encode(
             mem[koff:koff + klen].decode("utf-8", "replace")
         )
         voff, vlen = int(cols["bann_value_off"][j]), int(cols["bann_value_len"][j])
         btype = int(cols["bann_type"][j])
-        b.bann_type[j] = btype if 0 <= btype <= 6 else 1
-        from zipkin_tpu.wire.thrift import _decode_binary_value
-        from zipkin_tpu.models.span import AnnotationType
+        b.bann_type[bj] = btype if 0 <= btype <= 6 else 1
 
         value = _decode_binary_value(
-            mem[voff:voff + vlen], AnnotationType(int(b.bann_type[j]))
+            mem[voff:voff + vlen], AnnotationType(int(b.bann_type[bj]))
         )
         if isinstance(value, bytearray):
             value = bytes(value)
-        b.bann_value_id[j] = dicts.binary_values.encode(value)
+        b.bann_value_id[bj] = dicts.binary_values.encode(value)
         slen = int(cols["bann_svc_len"][j])
         if slen >= 0 or slen == -2:
             if slen == -2:
@@ -316,8 +361,9 @@ def parse_spans_columnar(
             else:
                 soff = int(cols["bann_svc_off"][j])
                 svc_name = mem[soff:soff + slen].decode("utf-8", "replace")
-            b.bann_service_id[j] = dicts.services.encode(svc_name.lower())
-            b.bann_endpoint_id[j] = dicts.endpoints.encode(
+            b.bann_service_id[bj] = dicts.services.encode(svc_name.lower())
+            b.bann_endpoint_id[bj] = dicts.endpoints.encode(
                 (int(cols["bann_ipv4"][j]), int(cols["bann_port"][j]), svc_name)
             )
-    return b, name_lc
+        bj += 1
+    return b, name_lc, dropped, kept_debug

@@ -52,6 +52,16 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+_SPAN_COLS = ("trace_id", "span_id", "parent_id", "name_id", "service_id",
+              "ts_cs", "ts_cr", "ts_sr", "ts_ss", "ts_first", "ts_last",
+              "duration", "flags")
+_ANN_COLS = ("ann_ts", "ann_value_id", "ann_service_id", "ann_endpoint_id")
+_BANN_COLS = ("bann_key_id", "bann_value_id", "bann_type",
+              "bann_service_id", "bann_endpoint_id")
+
+
+
+
 class TpuSpanStore(SpanStore):
     def __init__(self, config: Optional[dev.StoreConfig] = None,
                  codec: Optional[SpanCodec] = None):
@@ -151,19 +161,34 @@ class TpuSpanStore(SpanStore):
                 del self.ttls[tid]
                 excess -= 1
 
-    def write_thrift(self, payload: bytes) -> int:
+    def write_thrift(self, payload: bytes,
+                     sample_threshold: int = 0) -> Tuple[int, int, int]:
         """Native fast path: raw thrift Span sequence → device, bypassing
-        python span objects entirely. Returns the span count written.
+        python span objects entirely. Returns
+        (written, dropped, written_debug).
+
+        ``sample_threshold`` applies the sampler's trace-id test on the
+        numeric columns BEFORE string interning (Sampler.scala:39-48
+        semantics incl. the debug override, SpanSamplerFilter.scala:40-47)
+        so the fast path neither bypasses sampling nor pollutes the
+        dictionaries with sampled-out names; 0 keeps everything.
+        ``written_debug`` counts kept debug spans (the slow path never
+        runs those through the sampler's counters).
+
         Raises zipkin_tpu.native.NativeUnavailable when g++ is absent —
-        callers fall back to wire.thrift + apply()."""
+        callers fall back to wire.thrift + apply(); ParseCapacityError
+        propagates for callers to chunk."""
         from zipkin_tpu import native
 
         with self._lock:
-            batch, name_lc = native.parse_spans_columnar(
-                payload, self.dicts, max_spans=self.MAX_CHUNK
+            batch, name_lc, dropped, kept_debug = (
+                native.parse_spans_columnar_sampled(
+                    payload, self.dicts, sample_threshold,
+                    max_spans=self.MAX_CHUNK,
+                )
             )
             if batch.n_spans == 0:
-                return 0
+                return 0, dropped, 0
             for tid in np.unique(batch.trace_id):
                 self.ttls.setdefault(int(tid), 1.0)
             self._prune_ttls()
@@ -181,7 +206,7 @@ class TpuSpanStore(SpanStore):
                 with self._rw.write():
                     self.state = dev.ingest_step(self.state, db)
                 self._wp += int(part.n_spans)
-            return batch.n_spans
+            return batch.n_spans, dropped, kept_debug
 
     def _chunk_columnar(self, batch: SpanBatch, name_lc: np.ndarray,
                         indexable: np.ndarray):
@@ -223,17 +248,13 @@ class TpuSpanStore(SpanStore):
         out = SpanBatch.empty(
             stop - start, int(a_sel.sum()), int(b_sel.sum())
         )
-        for col in ("trace_id", "span_id", "parent_id", "name_id",
-                    "service_id", "ts_cs", "ts_cr", "ts_sr", "ts_ss",
-                    "ts_first", "ts_last", "duration", "flags"):
+        for col in _SPAN_COLS:
             setattr(out, col, getattr(batch, col)[start:stop])
         out.ann_span_idx = batch.ann_span_idx[a_sel] - start
-        for col in ("ann_ts", "ann_value_id", "ann_service_id",
-                    "ann_endpoint_id"):
+        for col in _ANN_COLS:
             setattr(out, col, getattr(batch, col)[a_sel])
         out.bann_span_idx = batch.bann_span_idx[b_sel] - start
-        for col in ("bann_key_id", "bann_value_id", "bann_type",
-                    "bann_service_id", "bann_endpoint_id"):
+        for col in _BANN_COLS:
             setattr(out, col, getattr(batch, col)[b_sel])
         return out
 
