@@ -61,6 +61,11 @@ class StoreConfig(NamedTuple):
     # silently clip long durations into the top bucket.
     quantile_buckets: int = 2048
     quantile_alpha: float = 0.01
+    # Route ingest scatter-adds through the VMEM-resident pallas
+    # histogram kernels (ops/pallas_kernels.py) instead of XLA scatter.
+    # Benchmarked on the real chip by bench.py --compare-kernels; arrays
+    # whose size is not a multiple of 128 lanes fall back to XLA.
+    use_pallas: bool = False
 
 
 def _ring(n, dtype, fill=0):
@@ -219,6 +224,19 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
             "batches": jnp.int64(0),
         },
     )
+
+
+def _scatter_add(counts, idx, weights, use_pallas: bool):
+    """``counts.reshape(-1)[idx] += weights`` with idx < 0 dropped —
+    the one primitive behind every ingest counter/presence/sketch
+    update (the reference's 5-index-writes-per-span hot loop,
+    processor/IndexService.scala:30-38). Dispatches to the
+    VMEM-resident pallas kernel when enabled and lane-aligned."""
+    from zipkin_tpu.ops import pallas_kernels as PK
+
+    if use_pallas and counts.size % PK.LANES == 0:
+        return PK.histogram_update(counts, idx, weights)
+    return PK.scatter_histogram_xla(counts, idx, weights)
 
 
 def svc_histogram(state: StoreState) -> Q.LogHistogram:
@@ -511,24 +529,27 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     # -- per-service latency histogram ---------------------------------
     hist = svc_histogram(state)
     svc_ok = mask & (b.service_id >= 0) & (b.service_id < S) & (b.duration >= 0)
-    hist = Q.update_grouped(
-        hist, jnp.clip(b.service_id, 0, S - 1), b.duration.astype(jnp.float32),
-        valid=svc_ok,
+    bidx = Q.bucket_index(hist, b.duration.astype(jnp.float32))
+    g = jnp.clip(b.service_id, 0, S - 1)
+    ones_p = jnp.ones(P, jnp.int32)
+    ones_a = jnp.ones(PA, jnp.int32)
+    upd["svc_hist"] = _scatter_add(
+        state.svc_hist,
+        jnp.where(svc_ok, g * c.quantile_buckets + bidx, -1),
+        ones_p, c.use_pallas,
     )
-    upd["svc_hist"] = hist.counts
 
     # -- counters / presence matrices ----------------------------------
-    svc_pad = jnp.where(mask & (b.service_id >= 0) & (b.service_id < S),
-                        b.service_id, S)
-    upd["svc_span_counts"] = (
-        jnp.concatenate([state.svc_span_counts, jnp.zeros(1, jnp.int32)])
-        .at[svc_pad].add(1)[:S]
+    svc_cnt_ok = mask & (b.service_id >= 0) & (b.service_id < S)
+    upd["svc_span_counts"] = _scatter_add(
+        state.svc_span_counts, jnp.where(svc_cnt_ok, b.service_id, -1),
+        ones_p, c.use_pallas,
     )
     a_svc = b.ann_service_id
-    a_svc_pad = jnp.where(mask_a & (a_svc >= 0) & (a_svc < S), a_svc, S)
-    upd["ann_svc_counts"] = (
-        jnp.concatenate([state.ann_svc_counts, jnp.zeros(1, jnp.int32)])
-        .at[a_svc_pad].add(1)[:S]
+    a_svc_ok = mask_a & (a_svc >= 0) & (a_svc < S)
+    upd["ann_svc_counts"] = _scatter_add(
+        state.ann_svc_counts, jnp.where(a_svc_ok, a_svc, -1),
+        ones_a, c.use_pallas,
     )
 
     # span-name presence keyed by annotation-host service (the semantics
@@ -537,29 +558,25 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     ann_name_lc = b.name_lc_id[b.ann_span_idx]
     ann_indexable = b.indexable[b.ann_span_idx]
     np_ok = (
-        mask_a & (a_svc >= 0) & (a_svc < S) & ann_indexable
+        a_svc_ok & ann_indexable
         & (ann_name_lc >= 0) & (ann_name >= 0) & (ann_name < c.max_span_names)
     )
-    np_flat = jnp.where(np_ok, a_svc * c.max_span_names + ann_name,
-                        S * c.max_span_names)
-    upd["name_presence"] = (
-        jnp.concatenate([state.name_presence.reshape(-1),
-                         jnp.zeros(1, jnp.int32)])
-        .at[np_flat].add(1)[:-1].reshape(S, c.max_span_names)
+    upd["name_presence"] = _scatter_add(
+        state.name_presence,
+        jnp.where(np_ok, a_svc * c.max_span_names + ann_name, -1),
+        ones_a, c.use_pallas,
     )
 
     # top annotations per service (user annotations only).
     av_ok = (
-        mask_a & (a_svc >= 0) & (a_svc < S)
+        a_svc_ok
         & (b.ann_value_id >= FIRST_USER_ANNOTATION_ID)
         & (b.ann_value_id < c.max_annotation_values)
     )
-    av_flat = jnp.where(av_ok, a_svc * c.max_annotation_values + b.ann_value_id,
-                        S * c.max_annotation_values)
-    upd["ann_value_counts"] = (
-        jnp.concatenate([state.ann_value_counts.reshape(-1),
-                         jnp.zeros(1, jnp.int32)])
-        .at[av_flat].add(1)[:-1].reshape(S, c.max_annotation_values)
+    upd["ann_value_counts"] = _scatter_add(
+        state.ann_value_counts,
+        jnp.where(av_ok, a_svc * c.max_annotation_values + b.ann_value_id, -1),
+        ones_a, c.use_pallas,
     )
 
     bk_svc = b.bann_service_id
@@ -567,12 +584,10 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         mask_b & (bk_svc >= 0) & (bk_svc < S)
         & (b.bann_key_id >= 0) & (b.bann_key_id < c.max_binary_keys)
     )
-    bk_flat = jnp.where(bk_ok, bk_svc * c.max_binary_keys + b.bann_key_id,
-                        S * c.max_binary_keys)
-    upd["bann_key_counts"] = (
-        jnp.concatenate([state.bann_key_counts.reshape(-1),
-                         jnp.zeros(1, jnp.int32)])
-        .at[bk_flat].add(1)[:-1].reshape(S, c.max_binary_keys)
+    upd["bann_key_counts"] = _scatter_add(
+        state.bann_key_counts,
+        jnp.where(bk_ok, bk_svc * c.max_binary_keys + b.bann_key_id, -1),
+        jnp.ones(PB, jnp.int32), c.use_pallas,
     )
 
     # -- probabilistic state -------------------------------------------
@@ -580,10 +595,16 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     upd["hll_traces"] = hll.update(
         hll.HyperLogLog(state.hll_traces), t_hi, t_lo, valid=mask
     ).registers
-    upd["cms_trace_spans"] = cms.update(
-        cms.CountMin(state.cms_trace_spans), t_hi, t_lo,
-        weights=mask.astype(state.cms_trace_spans.dtype),
-    ).counts
+    cms_sketch = cms.CountMin(state.cms_trace_spans)
+    cms_idx = cms._indices(cms_sketch, t_hi, t_lo)  # [depth, P]
+    cms_flat = cms_idx + (
+        jnp.arange(c.cms_depth, dtype=jnp.int32) * c.cms_width
+    )[:, None]
+    cms_flat = jnp.where(mask[None, :], cms_flat, -1).reshape(-1)
+    upd["cms_trace_spans"] = _scatter_add(
+        state.cms_trace_spans, cms_flat,
+        jnp.ones(c.cms_depth * P, jnp.int32), c.use_pallas,
+    )
 
     # -- time range + counters -----------------------------------------
     firsts = jnp.where(mask & (b.ts_first >= 0), b.ts_first, I64_MAX)
