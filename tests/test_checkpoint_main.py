@@ -56,6 +56,47 @@ class TestCheckpoint:
         # Dictionary ids survived: the same service maps to the same id.
         assert restored.dicts.services.get("api") == store.dicts.services.get("api")
 
+    def test_legacy_snapshot_without_watermark(self, tmp_path):
+        """A revision-1 snapshot (no dep_archived_gid leaf) must load with
+        the watermark at write_pos — its dep_moments bank already holds
+        every resident link, so a zero watermark would double-count."""
+        import os
+
+        store = TpuSpanStore(CFG)
+        store.apply([rpc(1, 1, None, 100, 200), rpc(1, 2, 1, 110, 150)])
+        # Archive everything so dep_moments is the complete bank, the
+        # shape a legacy snapshot carried.
+        from zipkin_tpu.store import device as dev
+
+        with store._rw.write():
+            store.state = dev.dep_archive_step(store.state, store.state.write_pos)
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(store, path)
+        expected = [(l.parent, l.child, l.duration_moments.count)
+                    for l in store.get_dependencies().links]
+
+        # Rewrite state.npz without the watermark leaf and meta.json
+        # without the revision field (the revision-1 layout).
+        import json
+
+        state_file = os.path.join(path, "state.npz")
+        data = dict(np.load(state_file))
+        del data["dep_archived_gid"]
+        np.savez_compressed(state_file, **data)
+        meta_file = os.path.join(path, "meta.json")
+        with open(meta_file) as f:
+            meta = json.load(f)
+        del meta["revision"]
+        with open(meta_file, "w") as f:
+            json.dump(meta, f)
+
+        restored = checkpoint.load(path)
+        assert int(restored.state.dep_archived_gid) == \
+            int(restored.state.write_pos)
+        got = [(l.parent, l.child, l.duration_moments.count)
+               for l in restored.get_dependencies().links]
+        assert got == expected
+
     def test_atomic_overwrite(self, tmp_path):
         store = TpuSpanStore(CFG)
         store.apply([rpc(1, 1, None, 100, 200)])

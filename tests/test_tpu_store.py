@@ -193,6 +193,49 @@ class TestReviewRegressions:
         store2.apply(spans)
         assert store2.counters()["spans_seen"] == 40
 
+    def test_single_span_annotation_overflow_truncated(self):
+        """One span with more annotations than the ring holds must be
+        truncated (counted), not yielded as-is — an oversized chunk wraps
+        the annotation ring and scatters colliding slots in one launch."""
+        from zipkin_tpu.columnar.schema import SpanBatch
+
+        cfg = StoreConfig(
+            capacity=64, ann_capacity=16, bann_capacity=16,
+            max_services=8, max_span_names=16, max_annotation_values=32,
+            max_binary_keys=8, cms_width=256, hll_p=4, quantile_buckets=64,
+        )
+        store = TpuSpanStore(cfg)
+        n_ann = 40
+        batch = SpanBatch.empty(1, n_ann, 0)
+        batch.trace_id[:] = 5
+        batch.span_id[:] = 1
+        batch.name_id[:] = store.dicts.span_names.encode("op")
+        batch.ann_span_idx[:] = 0
+        batch.ann_ts[:] = np.arange(n_ann)
+        batch.ann_value_id[:] = 1
+        chunks = list(store._chunk_columnar(
+            batch, np.full(1, -1, np.int32), np.ones(1, bool)
+        ))
+        assert all(p.n_annotations <= cfg.ann_capacity for p, _, _ in chunks)
+        assert store.anns_truncated == n_ann - cfg.ann_capacity
+        for part, lc, ix in chunks:
+            store.write_batch(part, ix)
+        assert store.counters()["spans_seen"] == 1
+
+        # The python slow path (apply) takes the same guard: a fat span
+        # is truncated, not the whole batch dropped.
+        store2 = TpuSpanStore(cfg)
+        fat = Span(7, "op", 1, None, tuple(
+            Annotation(100 + i, f"a{i}", Endpoint(1, 1, "svc"))
+            for i in range(n_ann)
+        ), ())
+        store2.apply([fat, Span(8, "op", 2, None,
+                                (Annotation(10, "x", Endpoint(1, 1, "svc")),),
+                                ())])
+        assert store2.counters()["spans_seen"] == 2
+        assert store2.anns_truncated > 0
+        assert store2.traces_exist([7, 8]) == {7, 8}
+
 
 class TestRingEviction:
     def test_overwrite_drops_old_traces(self):

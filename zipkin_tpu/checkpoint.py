@@ -28,6 +28,8 @@ from zipkin_tpu.store.tpu import TpuSpanStore
 
 _STATE_FILE = "state.npz"
 _META_FILE = "meta.json"
+# Bump when the StoreState schema changes in a way load() must adapt to.
+_REVISION = 2
 
 
 def _dict_dump(d) -> list:
@@ -71,6 +73,7 @@ def save(store: TpuSpanStore, path: str) -> None:
             else:
                 leaves[name] = np.asarray(value)
     meta = {
+        "revision": _REVISION,
         "config": store.config._asdict(),
         "ttls": {str(k): v for k, v in store.ttls.items()},
         "name_lc": {str(k): v for k, v in store._name_lc.items()},
@@ -148,6 +151,14 @@ def load(path: str) -> TpuSpanStore:
         else:
             upd[key] = jax.numpy.asarray(data[key])
     upd["counters"] = counters
+    if meta.get("revision", 1) < 2 and "dep_archived_gid" not in upd:
+        # Revision-1 snapshot (pre-watermark): its dep_moments bank was
+        # the complete link state at save time, so treat it as fully
+        # archived — a zero watermark would re-join every resident child
+        # via live_dep_moments and double-count.
+        upd["dep_archived_gid"] = jax.numpy.asarray(
+            np.int64(data["write_pos"])
+        )
     with store._rw.write():
         store.state = store.state.replace(**upd)
     # Re-seed the host mirrors that drive the dependency-archive policy.

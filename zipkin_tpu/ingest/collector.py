@@ -10,6 +10,7 @@ moves the sampler's rate (AdaptiveSampler wiring, SURVEY.md §3.5).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -59,6 +60,9 @@ class Collector:
         self.spans_dropped = 0
         self.spans_stored = 0
         self.bad_payloads = 0
+        # Counters are read-modify-written from every queue worker; the
+        # adaptive controller reads them, so lost increments skew rates.
+        self._stats_lock = threading.Lock()
         # The fast path needs both the native parser and a store that
         # accepts raw thrift (TpuSpanStore.write_thrift); probed once.
         self._fast_ok: Optional[bool] = None
@@ -96,10 +100,12 @@ class Collector:
             return
         spans = item
         kept = [s for s in spans if s.debug or self.sampler(s.trace_id)]
-        self.spans_dropped += len(spans) - len(kept)
+        with self._stats_lock:
+            self.spans_dropped += len(spans) - len(kept)
         if kept:
             self.store.apply(kept)
-            self.spans_stored += len(kept)
+            with self._stats_lock:
+                self.spans_stored += len(kept)
 
     def _write_thrift(self, segments) -> None:
         if not self._fast_path_available():
@@ -128,10 +134,10 @@ class Collector:
             self._decode_segments_slow(segments)
             return
         # Slow-path counter parity: debug spans never hit the sampler.
-        self.sampler.allowed += written - written_debug
-        self.sampler.denied += dropped
-        self.spans_stored += written
-        self.spans_dropped += dropped
+        self.sampler.count(written - written_debug, dropped)
+        with self._stats_lock:
+            self.spans_stored += written
+            self.spans_dropped += dropped
 
     def _decode_segments_slow(self, segments) -> None:
         from zipkin_tpu.wire.thrift import ThriftError, spans_from_bytes
@@ -141,7 +147,8 @@ class Collector:
             try:
                 spans.extend(spans_from_bytes(seg))
             except ThriftError:
-                self.bad_payloads += 1
+                with self._stats_lock:
+                    self.bad_payloads += 1
         if spans:
             self._write(spans)
 

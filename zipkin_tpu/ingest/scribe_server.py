@@ -95,9 +95,13 @@ def _parse_log_args(r: _Reader) -> List[Tuple[str, str]]:
 
 
 def _reply(name: str, seqid: int, code: ResultCode) -> bytes:
+    # Thrift string length is the UTF-8 byte count, not code points — a
+    # non-ASCII method name (e.g. from a 'replace'-decoded bad frame)
+    # must not desync the reply framing.
+    nb = name.encode()
     body = [
         struct.pack(">I", (VERSION_1 | MSG_REPLY) & 0xFFFFFFFF),
-        struct.pack(">i", len(name)), name.encode(),
+        struct.pack(">i", len(nb)), nb,
         struct.pack(">i", seqid),
         # result struct: {0: i32 success}
         struct.pack(">bh", T_I32, 0), struct.pack(">i", code.value),
@@ -107,13 +111,15 @@ def _reply(name: str, seqid: int, code: ResultCode) -> bytes:
 
 
 def _exception_reply(name: str, seqid: int, message: str) -> bytes:
+    nb = name.encode()
+    mb = message.encode()
     body = [
         struct.pack(">I", (VERSION_1 | MSG_EXCEPTION) & 0xFFFFFFFF),
-        struct.pack(">i", len(name)), name.encode(),
+        struct.pack(">i", len(nb)), nb,
         struct.pack(">i", seqid),
         # TApplicationException {1: string message, 2: i32 type}
         struct.pack(">bh", T_STRING, 1),
-        struct.pack(">i", len(message)), message.encode(),
+        struct.pack(">i", len(mb)), mb,
         struct.pack(">bh", T_I32, 2), struct.pack(">i", 1),  # UNKNOWN_METHOD
         b"\x00",
     ]

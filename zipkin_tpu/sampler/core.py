@@ -17,6 +17,8 @@ TPU float64 is needed.
 
 from __future__ import annotations
 
+import threading
+
 import jax.numpy as jnp
 
 LONG_MAX = (1 << 63) - 1
@@ -52,19 +54,31 @@ class Sampler:
         self.rate = rate
         self.allowed = 0
         self.denied = 0
+        # Counters are bumped from every collector worker thread; an
+        # unlocked read-modify-write loses increments under concurrency
+        # and skews the adaptive controller's inputs.
+        self.lock = threading.Lock()
 
     @property
     def threshold(self) -> int:
         return rate_to_threshold(self.rate)
 
+    def count(self, allowed: int, denied: int) -> None:
+        """Thread-safe bulk counter update (fast-path batches)."""
+        with self.lock:
+            self.allowed += allowed
+            self.denied += denied
+
     def __call__(self, trace_id: int) -> bool:
         if self.rate >= 1.0:
-            self.allowed += 1
+            with self.lock:
+                self.allowed += 1
             return True
         t = LONG_MAX if trace_id == LONG_MIN else abs(trace_id)
         allow = t > self.threshold
-        if allow:
-            self.allowed += 1
-        else:
-            self.denied += 1
+        with self.lock:
+            if allow:
+                self.allowed += 1
+            else:
+                self.denied += 1
         return allow

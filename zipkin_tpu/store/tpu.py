@@ -82,6 +82,10 @@ class TpuSpanStore(SpanStore):
         # Keyed by to_signed64(trace_id) — ids >= 2^63 arrive unsigned
         # on some write paths and signed on others.
         self.ttls: Dict[int, float] = {}
+        # Annotation rows dropped because a single span carried more than
+        # a ring's capacity (the maxTraceCols-style guard).
+        self.anns_truncated = 0
+        self.banns_truncated = 0
         # name_id -> lowercased-name id, maintained incrementally.
         self._name_lc: Dict[int, int] = {}
 
@@ -121,13 +125,19 @@ class TpuSpanStore(SpanStore):
             # Chunking keeps jit shapes bounded and batches under ring
             # capacity (a single launch must not scatter colliding
             # slots); trace grouping just keeps each trace's rows
-            # adjacent in the ring.
+            # adjacent in the ring. _chunk_columnar additionally guards
+            # the annotation rings (one fat span's rows get truncated,
+            # not the whole batch dropped).
             for part in self._chunk_by_trace(spans):
                 batch = self.codec.encode(part)
                 indexable = np.fromiter(
                     (should_index(s) for s in part), bool, len(part)
                 )
-                self.write_batch(batch, indexable)
+                name_lc = self._name_lc_ids(batch)
+                for cb, clc, cix in self._chunk_columnar(
+                    batch, name_lc, indexable
+                ):
+                    self._write_device(cb, clc, cix)
 
     def _chunk_by_trace(self, spans: Sequence[Span]):
         chunk_size = min(self.MAX_CHUNK, self.config.capacity // 2 or 1)
@@ -196,16 +206,7 @@ class TpuSpanStore(SpanStore):
             for part, part_lc, part_ix in self._chunk_columnar(
                 batch, name_lc, indexable
             ):
-                db = dev.make_device_batch(
-                    part, name_lc_id=part_lc, indexable=part_ix,
-                    pad_spans=_next_pow2(part.n_spans),
-                    pad_anns=_next_pow2(part.n_annotations),
-                    pad_banns=_next_pow2(part.n_binary),
-                )
-                self._maybe_archive(int(part.n_spans))
-                with self._rw.write():
-                    self.state = dev.ingest_step(self.state, db)
-                self._wp += int(part.n_spans)
+                self._write_device(part, part_lc, part_ix)
             return batch.n_spans, dropped, kept_debug
 
     def _chunk_columnar(self, batch: SpanBatch, name_lc: np.ndarray,
@@ -235,9 +236,29 @@ class TpuSpanStore(SpanStore):
                 if a_n <= c.ann_capacity and b_n <= c.bann_capacity:
                     break
                 stop = start + (stop - start) // 2
-            yield (self._slice_batch(batch, start, stop),
-                   name_lc[start:stop], indexable[start:stop])
+            part = self._slice_batch(batch, start, stop)
+            # A single span can carry more annotations than a ring holds;
+            # yielding it as-is would wrap the ring and scatter colliding
+            # slots nondeterministically in one launch. Truncate its
+            # annotation rows instead (counted, like maxTraceCols drops).
+            if part.n_annotations > c.ann_capacity:
+                self.anns_truncated += part.n_annotations - c.ann_capacity
+                part = self._truncate_anns(part, c.ann_capacity, binary=False)
+            if part.n_binary > c.bann_capacity:
+                self.banns_truncated += part.n_binary - c.bann_capacity
+                part = self._truncate_anns(part, c.bann_capacity, binary=True)
+            yield part, name_lc[start:stop], indexable[start:stop]
             start = stop
+
+    @staticmethod
+    def _truncate_anns(batch: SpanBatch, cap: int, binary: bool) -> SpanBatch:
+        """Keep only the first ``cap`` (binary) annotation rows."""
+        import dataclasses
+
+        cols = SpanBatch.BANN_COLUMNS if binary else SpanBatch.ANN_COLUMNS
+        return dataclasses.replace(
+            batch, **{c: getattr(batch, c)[:cap] for c in cols}
+        )
 
     @staticmethod
     def _slice_batch(batch: SpanBatch, start: int, stop: int) -> SpanBatch:
@@ -275,9 +296,15 @@ class TpuSpanStore(SpanStore):
                 f"({c.capacity}/{c.ann_capacity}/{c.bann_capacity}); "
                 "split into smaller batches"
             )
+        self._write_device(batch, self._name_lc_ids(batch), indexable)
+
+    def _write_device(self, batch: SpanBatch, name_lc: np.ndarray,
+                      indexable: np.ndarray) -> None:
+        """Pad, upload, and run the fused ingest step for one chunk that
+        already fits the ring capacities."""
         db = dev.make_device_batch(
             batch,
-            name_lc_id=self._name_lc_ids(batch),
+            name_lc_id=name_lc,
             indexable=indexable,
             pad_spans=_next_pow2(batch.n_spans),
             pad_anns=_next_pow2(batch.n_annotations),
