@@ -1,0 +1,146 @@
+"""SURVEY §5 determinism test — the race-detector analogue.
+
+The reference leans on JVM memory-safety primitives (ArrayBlockingQueue,
+synchronized); the TPU build's equivalent guarantee is *replayability*:
+the same device batches, ingested in the same order into a fresh state,
+must produce bitwise-identical arrays — every sketch register, ring
+slot, and counter. This locks in the scatter-order assumptions the
+capacity guards in store/tpu.py depend on (colliding slot writes within
+one launch would be implementation-defined and would fail this test).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from zipkin_tpu.store import device as dev
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.tracegen import ColumnarTraceGen
+
+CONFIG = dev.StoreConfig(
+    capacity=256, ann_capacity=1024, bann_capacity=512,
+    max_services=16, max_span_names=32, max_annotation_values=64,
+    max_binary_keys=16, cms_width=256, hll_p=6, quantile_buckets=128,
+)
+
+
+def _device_batches(n_batches=4, n_traces=8):
+    store = TpuSpanStore(CONFIG)
+    gen = ColumnarTraceGen(store.dicts, n_services=8, n_span_names=16,
+                           spans_per_trace=7)
+    out = []
+    for _ in range(n_batches):
+        batch, name_lc, indexable = gen.next_batch(n_traces)
+        out.append(dev.make_device_batch(
+            batch, name_lc, indexable,
+            pad_spans=64, pad_anns=128, pad_banns=64,
+        ))
+    return out
+
+
+def _run(batches):
+    state = dev.init_state(CONFIG)
+    for db in batches:
+        state = dev.ingest_step(state, db)
+    # Include the archive step: its full-ring join must be as
+    # deterministic as the ingest scatters it depends on.
+    state = dev.dep_archive_auto(state, batches[-1].trace_id.shape[0])
+    return state
+
+
+def _leaves(state):
+    flat, _ = jax.tree_util.tree_flatten(state)
+    return [np.asarray(x) for x in flat]
+
+
+def test_same_batches_bitwise_same_state():
+    batches = _device_batches()
+    a = _leaves(_run(batches))
+    b = _leaves(_run(batches))
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(
+            x, y, err_msg=f"leaf {i} diverged between identical replays"
+        )
+
+
+def test_query_results_deterministic():
+    """Same state → same query winners (the device kernels sort with
+    stable composite keys; ties must not flap between calls)."""
+    batches = _device_batches()
+    state = _run(batches)
+    r1 = dev.query_trace_ids_by_service(state, 0, -1, 2**62, 8)
+    r2 = dev.query_trace_ids_by_service(state, 0, -1, 2**62, 8)
+    for x, y in zip(r1, r2):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_control_loop_reads_device_counters():
+    """The adaptive controller's flow source is the store's device
+    counter, not host accounting (AdaptiveSampler.scala:204-237's group
+    sum, re-expressed as the psum-able spans_seen scalar)."""
+    from zipkin_tpu.ingest.collector import Collector
+    from zipkin_tpu.sampler.adaptive import AdaptiveConfig
+    from zipkin_tpu.tracegen import generate_traces
+
+    store = TpuSpanStore(CONFIG)
+    cfg = AdaptiveConfig(
+        target_store_rate=60.0,  # spans/minute target
+        update_freq_s=1.0, window_s=4.0, sufficient_window_s=2.0,
+        outlier_window_s=1.0,
+    )
+    collector = Collector(store, adaptive=cfg, concurrency=1)
+    spans = [s for t in generate_traces(n_traces=20, max_depth=3) for s in t]
+    t0 = 1000.0
+    collector.control_tick(now_s=t0)
+    # Poison the host counter: if control_tick read it, the flow would be
+    # absurd and the rate would not follow the device counter's story.
+    collector.spans_stored = 10**9
+    n_ticks = 6
+    per_tick = max(1, len(spans) // n_ticks)
+    rate_before = collector.sampler.rate
+    for i in range(n_ticks):
+        collector.accept(spans[i * per_tick:(i + 1) * per_tick])
+        collector.flush()
+        collector.control_tick(now_s=t0 + (i + 1) * cfg.update_freq_s)
+    # Device counter says ~200 spans/min >> 60 target → rate must drop.
+    assert collector.sampler.rate < rate_before
+    assert store.stored_span_count() == float(
+        store.state.counters["spans_seen"]
+    )
+
+
+def test_extreme_trace_id_queryable():
+    """trace_id == 2^63-1 is a valid id and must survive the dedup's
+    sort keys (regression: an I64_MAX sentinel on the trace-id key made
+    such traces unqueryable)."""
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+
+    ep = Endpoint(1, 80, "edge")
+    tid = 2**63 - 1
+    span = Span(tid, "op", 7, None,
+                (Annotation(10, "sr", ep), Annotation(11, "custom", ep)), ())
+    store = TpuSpanStore(CONFIG)
+    store.apply([span])
+    res = store.get_trace_ids_by_name("edge", None, 100, 3)
+    assert [i.trace_id for i in res] == [tid]
+    res2 = store.get_trace_ids_by_annotation("edge", "custom", None, 100, 3)
+    assert [i.trace_id for i in res2] == [tid]
+
+
+def test_stored_span_count_sources():
+    from zipkin_tpu.store.memory import InMemorySpanStore
+    from zipkin_tpu.store.sql import SqliteSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    spans = [s for t in generate_traces(n_traces=3, max_depth=3) for s in t]
+    mem = InMemorySpanStore()
+    mem.apply(spans)
+    assert mem.stored_span_count() == float(len(spans))
+    sql = SqliteSpanStore()
+    sql.apply(spans)
+    assert sql.stored_span_count() == float(len(spans))
+    sql.close()

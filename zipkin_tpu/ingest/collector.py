@@ -99,7 +99,11 @@ class Collector:
             self._write_thrift(item.segments)
             return
         spans = item
-        kept = [s for s in spans if s.debug or self.sampler(s.trace_id)]
+        kept = [s for s in spans if s.debug or self.sampler.decide(s.trace_id)]
+        # One locked counter update per batch (debug spans bypass the
+        # sampler and are not counted, matching the fast path).
+        n_debug = sum(1 for s in kept if s.debug)
+        self.sampler.count(len(kept) - n_debug, len(spans) - len(kept))
         with self._stats_lock:
             self.spans_dropped += len(spans) - len(kept)
         if kept:
@@ -170,7 +174,15 @@ class Collector:
         if self._last_tick_s is not None and now_s - self._last_tick_s < freq:
             return None
         self._last_tick_s = now_s
-        rate = self._flow.observe(float(self.spans_stored), now_s)
+        # Flow source: the store's own counters (the device spans_seen
+        # scalar on the TPU store; a psum-ed shard summary when sharded)
+        # — BASELINE's "sampler reads its counts directly from the
+        # on-device sketches". Host accounting is only the fallback for
+        # stores without counters.
+        stored = self.store.stored_span_count()
+        if stored is None:
+            stored = float(self.spans_stored)
+        rate = self._flow.observe(stored, now_s)
         if rate is None:
             return None
         new_rate = self.controller.observe(rate, now_s)

@@ -69,16 +69,16 @@ class Sampler:
             self.allowed += allowed
             self.denied += denied
 
-    def __call__(self, trace_id: int) -> bool:
+    def decide(self, trace_id: int) -> bool:
+        """Pure threshold test, no counters, no lock — batch callers
+        fold their decisions into one count() per batch instead of
+        taking the lock once per span."""
         if self.rate >= 1.0:
-            with self.lock:
-                self.allowed += 1
             return True
         t = LONG_MAX if trace_id == LONG_MIN else abs(trace_id)
-        allow = t > self.threshold
-        with self.lock:
-            if allow:
-                self.allowed += 1
-            else:
-                self.denied += 1
+        return t > self.threshold
+
+    def __call__(self, trace_id: int) -> bool:
+        allow = self.decide(trace_id)
+        self.count(int(allow), int(not allow))
         return allow

@@ -72,6 +72,15 @@ class WriteSpanStore(abc.ABC):
     def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
         """Pin/extend a trace's retention (SpanStore.scala:66)."""
 
+    def stored_span_count(self) -> Optional[float]:
+        """Total spans ever admitted, from the store's own counters —
+        the adaptive sampler's flow source (the device ``spans_seen``
+        counter on the TPU store; psum-ed across shards when sharded —
+        replacing the reference's ZK group sum,
+        AdaptiveSampler.scala:204-237). None = unknown; callers fall
+        back to host-side accounting."""
+        return None
+
     def close(self) -> None:
         pass
 

@@ -643,24 +643,32 @@ def _bann_span_slot(state: StoreState):
     return slot, live
 
 
-def _dedup_topk_by_ts(gid, tid, ts, valid, k: int):
-    """Dedup candidate span rows by gid, then take top-k by ts desc.
+def _dedup_topk_by_ts(tid, ts, valid, k: int):
+    """Dedup candidate rows by TRACE id (keeping each trace's max ts),
+    then take top-k traces by ts desc.
 
-    Returns (tids[k], tss[k], valid[k]). Mirrors the in-memory store's
-    "sort matched spans by last timestamp desc, truncate" semantics.
+    Returns (tids[k], tss[k], valid[k]). One trace with many matching
+    spans occupies exactly one of the ``k`` slots — the query layer's
+    result is trace ids, so per-span candidates must collapse before the
+    limit applies (the reference uniques ids after its index scan;
+    truncating per-span would let one hot trace crowd out the rest).
     """
-    # Sort by gid then mark first occurrence.
-    n = gid.shape[0]
-    gid_key = jnp.where(valid, gid, I64_MAX)
-    order = jnp.argsort(gid_key)
-    g_sorted = gid_key[order]
+    # Sort by (validity, trace id, ts desc): invalid rows sort last as a
+    # group (no sentinel on the trace id itself — a live trace id may
+    # legitimately equal I64_MAX), so the first occurrence of each trace
+    # id in the valid prefix is that trace's most recent matching span.
+    # Valid ts are >= 0 so -ts never overflows.
+    invalid = ~valid
+    neg_ts = jnp.where(valid, -ts, 0)
+    order = jnp.lexsort((neg_ts, tid, invalid))
+    t_sorted = tid[order]
+    v_sorted = valid[order]
     first = jnp.concatenate(
-        [jnp.ones(1, bool), g_sorted[1:] != g_sorted[:-1]]
-    ) & (g_sorted != I64_MAX)
-    rep_valid = first
+        [jnp.ones(1, bool), t_sorted[1:] != t_sorted[:-1]]
+    )
+    rep_valid = first & v_sorted
     ts_s, tid_s = ts[order], tid[order]
-    # Top-k by ts desc among representatives. Valid ts are >= 0, so -ts
-    # never overflows; invalid rows get I64_MAX and sort last.
+    # Top-k by ts desc among the per-trace representatives.
     neg_key = jnp.where(rep_valid, -ts_s, I64_MAX)
     sel = jnp.argsort(neg_key)[:k]
     return tid_s[sel], ts_s[sel], rep_valid[sel]
@@ -682,7 +690,7 @@ def query_trace_ids_by_service(
     ok &= (name_lc_id < 0) | (state.name_lc_id[slot] == name_lc_id)
     ts = state.ts_last[slot]
     ok &= (ts >= 0) & (ts <= end_ts)
-    return _dedup_topk_by_ts(state.ann_gid, state.trace_id[slot], ts, ok, limit)
+    return _dedup_topk_by_ts(state.trace_id[slot], ts, ok, limit)
 
 
 @partial(jax.jit, static_argnums=(7,))
@@ -727,11 +735,10 @@ def query_trace_ids_by_annotation(
     b_ts = state.ts_last[b_slot]
     b_ok &= (b_ts >= 0) & (b_ts <= end_ts)
 
-    gid = jnp.concatenate([state.ann_gid, state.bann_gid])
     tid = jnp.concatenate([state.trace_id[a_slot], state.trace_id[b_slot]])
     ts = jnp.concatenate([a_ts, b_ts])
     ok = jnp.concatenate([a_ok, b_ok])
-    return _dedup_topk_by_ts(gid, tid, ts, ok, limit)
+    return _dedup_topk_by_ts(tid, ts, ok, limit)
 
 
 def _span_has_service(state: StoreState, span_slot, svc_id):

@@ -6,8 +6,11 @@ checked against. Deliberate deviations from the reference's *in-memory*
 store, each matching what its *real* backends (Cassandra/anormdb) do
 instead:
 
-- indexed-id results are sorted by timestamp descending before the limit
-  is applied (the reference in-memory store truncates in insertion order);
+- indexed-id results are deduplicated by trace id (keeping the trace's
+  most recent matching span) and sorted by timestamp descending before
+  the limit is applied (the reference in-memory store truncates per-span
+  in insertion order; its query layer uniques ids afterwards — deduping
+  before the limit keeps one hot trace from crowding out the rest);
 - binary-annotation *keys* match annotation queries even without a value
   (Cassandra writes AnnotationsIndex rows for binary-annotation keys,
   CassieSpanStore.scala:168-251);
@@ -95,10 +98,7 @@ class InMemorySpanStore(SpanStore):
             for s in matched
             if s.last_timestamp is not None and s.last_timestamp <= end_ts
         ]
-        matched.sort(key=lambda s: s.last_timestamp, reverse=True)
-        return [
-            IndexedTraceId(s.trace_id, s.last_timestamp) for s in matched[:limit]
-        ]
+        return _dedup_limit(matched, limit)
 
     def get_trace_ids_by_annotation(
         self,
@@ -127,10 +127,7 @@ class InMemorySpanStore(SpanStore):
                 )
             if ok:
                 matched.append(s)
-        matched.sort(key=lambda s: s.last_timestamp, reverse=True)
-        return [
-            IndexedTraceId(s.trace_id, s.last_timestamp) for s in matched[:limit]
-        ]
+        return _dedup_limit(matched, limit)
 
     def get_traces_duration(self, trace_ids: Sequence[int]) -> List[TraceIdDuration]:
         with self._lock:
@@ -148,6 +145,10 @@ class InMemorySpanStore(SpanStore):
                 out.append(TraceIdDuration(tid, max(ts) - min(ts), min(ts)))
         return out
 
+    def stored_span_count(self) -> float:
+        with self._lock:
+            return float(len(self.spans))
+
     def get_all_service_names(self) -> Set[str]:
         with self._lock:
             snapshot = list(self.spans)
@@ -155,4 +156,15 @@ class InMemorySpanStore(SpanStore):
 
     def get_span_names(self, service: str) -> Set[str]:
         return {s.name for s in self._spans_for_service(service) if s.name}
+
+
+def _dedup_limit(matched: List[Span], limit: int) -> List[IndexedTraceId]:
+    """One IndexedTraceId per trace (max last_timestamp), ts desc, limit."""
+    best: Dict[int, int] = {}
+    for s in matched:
+        ts = s.last_timestamp
+        if ts is not None and ts > best.get(s.trace_id, -1):
+            best[s.trace_id] = ts
+    ranked = sorted(best.items(), key=lambda kv: kv[1], reverse=True)
+    return [IndexedTraceId(tid, ts) for tid, ts in ranked[:limit]]
 

@@ -117,10 +117,19 @@ class SqliteSpanStore(SpanStore):
         with self._lock:
             self._conn.executescript(_DDL)
             self._conn.commit()
+            # Monotonic admit counter for the flow estimator — COUNT(*)
+            # would scan the whole table under the lock on every control
+            # tick. Seeded from the table so reopened stores keep counting.
+            row = self._conn.execute("SELECT COUNT(*) FROM spans").fetchone()
+            self._stored = int(row[0])
 
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+    def stored_span_count(self) -> float:
+        with self._lock:
+            return float(self._stored)
 
     # -- writes ---------------------------------------------------------
 
@@ -177,6 +186,9 @@ class SqliteSpanStore(SpanStore):
                         ),
                     )
             self._conn.commit()
+            # Count only after the batch committed — a failed apply()
+            # must not inflate the adaptive controller's flow source.
+            self._stored += len(spans)
 
     def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
         with self._lock:
@@ -266,8 +278,10 @@ class SqliteSpanStore(SpanStore):
         self, service_name: str, span_name: Optional[str],
         end_ts: int, limit: int,
     ) -> List[IndexedTraceId]:
+        # One row per TRACE (max ts_last), so a hot trace fills one limit
+        # slot — same dedup-before-limit semantics as the other stores.
         q = (
-            "SELECT DISTINCT s.row, s.trace_id, s.ts_last FROM spans s"
+            "SELECT s.trace_id, MAX(s.ts_last) AS mts FROM spans s"
             " JOIN annotations a ON a.span_row = s.row"
             " WHERE s.indexable = 1 AND a.service_lc = ?"
             " AND s.ts_last IS NOT NULL AND s.ts_last <= ?"
@@ -276,11 +290,11 @@ class SqliteSpanStore(SpanStore):
         if span_name is not None:
             q += " AND s.name_lc = ?"
             args.append(span_name.lower())
-        q += " ORDER BY s.ts_last DESC LIMIT ?"
+        q += " GROUP BY s.trace_id ORDER BY mts DESC LIMIT ?"
         args.append(limit)
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()
-        return [IndexedTraceId(tid, ts) for _, tid, ts in rows]
+        return [IndexedTraceId(tid, ts) for tid, ts in rows]
 
     def get_trace_ids_by_annotation(
         self, service_name: str, annotation: str, value: Optional[bytes],
@@ -310,13 +324,13 @@ class SqliteSpanStore(SpanStore):
             )
             args = [end_ts, svc, annotation, annotation]
         q = (
-            "SELECT DISTINCT s.row, s.trace_id, s.ts_last" + base + match
-            + " ORDER BY s.ts_last DESC LIMIT ?"
+            "SELECT s.trace_id, MAX(s.ts_last) AS mts" + base + match
+            + " GROUP BY s.trace_id ORDER BY mts DESC LIMIT ?"
         )
         args.append(limit)
         with self._lock:
             rows = self._conn.execute(q, args).fetchall()
-        return [IndexedTraceId(tid, ts) for _, tid, ts in rows]
+        return [IndexedTraceId(tid, ts) for tid, ts in rows]
 
     def get_traces_duration(self, trace_ids: Sequence[int]
                             ) -> List[TraceIdDuration]:

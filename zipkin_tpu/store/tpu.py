@@ -631,3 +631,10 @@ class TpuSpanStore(SpanStore):
     def counters(self) -> Dict[str, float]:
         with self._rw.read():
             return {k: float(v) for k, v in self.state.counters.items()}
+
+    def stored_span_count(self) -> float:
+        """The DEVICE spans_seen counter (one scalar D2H per control
+        tick) — the adaptive controller's flow source reads the sketch
+        state itself, not a host mirror."""
+        with self._rw.read():
+            return float(self.state.counters["spans_seen"])

@@ -191,6 +191,23 @@ def _(factory):
     assert store.get_span_names("service") == set()
 
 
+@_test("one trace with many matching spans fills one limit slot")
+def _(factory):
+    # Trace 123 has three spans carrying "custom"; trace 999 has one,
+    # older. With limit 2 the hot trace must collapse to a single slot
+    # (its most recent span's ts) so trace 999 still surfaces.
+    hot1 = Span(123, "methodcall", 1, None, (Annotation(10, "custom", EP),), ())
+    hot2 = Span(123, "methodcall", 2, None, (Annotation(11, "custom", EP),), ())
+    hot3 = Span(123, "methodcall", 3, None, (Annotation(12, "custom", EP),), ())
+    cold = Span(999, "methodcall", 4, None, (Annotation(5, "custom", EP),), ())
+    store = _load(factory, [hot1, hot2, hot3, cold])
+    res = store.get_trace_ids_by_annotation("service", "custom", None, 100, 2)
+    assert [i.trace_id for i in res] == [123, 999]
+    assert res[0].timestamp == 12
+    by_name = store.get_trace_ids_by_name("service", None, 100, 2)
+    assert [i.trace_id for i in by_name] == [123, 999]
+
+
 @_test("end_ts filters results")
 def _(factory):
     store = _load(factory, [SPAN1])  # last annotation at ts 20
