@@ -1,0 +1,156 @@
+"""Time-bucketed dependency banks: get_dependencies(start, end) honesty.
+
+Reference: Aggregates.getDependencies(startDate, endDate)
+(Aggregates.scala:26-31); the hourly Dependencies rows the anormdb/
+cassandra aggregators persist (Dependencies.scala:59-67). Here each
+archive pass lands in a time-tagged device bank; a window query folds
+only overlapping banks (+ the live unarchived window).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from zipkin_tpu.models.span import Annotation, Endpoint, Span
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.tpu import TpuSpanStore
+
+HOUR = 3_600_000_000  # µs
+
+CFG = StoreConfig(
+    capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+    max_services=32, max_span_names=64, max_annotation_values=128,
+    max_binary_keys=32, cms_width=512, hll_p=6, quantile_buckets=128,
+    dep_buckets=4,
+)
+
+
+def _pair(parent_svc, child_svc, tid, base_ts):
+    pa = Endpoint(1, 80, parent_svc)
+    ca = Endpoint(2, 80, child_svc)
+    parent = Span(tid, "op", 1, None,
+                  (Annotation(base_ts, "sr", pa),
+                   Annotation(base_ts + 100, "ss", pa)), ())
+    child = Span(tid, "op2", 2, 1,
+                 (Annotation(base_ts + 10, "sr", ca),
+                  Annotation(base_ts + 60, "ss", ca)), ())
+    return [parent, child]
+
+
+def _links(deps):
+    return {(l.parent, l.child) for l in deps.links}
+
+
+def test_dependencies_honor_time_window():
+    store = TpuSpanStore(CFG)
+    store.apply(_pair("alpha", "beta", 100, 1 * HOUR))
+    store.archive_now()
+    store.apply(_pair("gamma", "delta", 200, 2 * HOUR))
+    store.archive_now()
+
+    assert _links(store.get_dependencies()) == {
+        ("alpha", "beta"), ("gamma", "delta")
+    }
+    h1 = store.get_dependencies(1 * HOUR, 2 * HOUR - 1)
+    assert _links(h1) == {("alpha", "beta")}
+    h2 = store.get_dependencies(2 * HOUR, 3 * HOUR)
+    assert _links(h2) == {("gamma", "delta")}
+    assert _links(store.get_dependencies(5 * HOUR, 6 * HOUR)) == set()
+    # Dependencies ts range reflects the window clip.
+    assert h1.end_time <= 2 * HOUR - 1
+    assert h2.start_time >= 2 * HOUR
+
+
+def test_live_unarchived_window_included():
+    store = TpuSpanStore(CFG)
+    store.apply(_pair("alpha", "beta", 100, 1 * HOUR))
+    store.archive_now()
+    # Hour-3 traffic stays live (no archive pass yet).
+    store.apply(_pair("eps", "zeta", 300, 3 * HOUR))
+    h3 = store.get_dependencies(3 * HOUR, 4 * HOUR)
+    assert _links(h3) == {("eps", "zeta")}
+    assert _links(store.get_dependencies()) == {
+        ("alpha", "beta"), ("eps", "zeta")
+    }
+
+
+def test_bucket_ring_overflow_preserves_totals():
+    """More archive passes than dep_buckets: displaced banks fold into
+    the all-time tail — totals never regress, only window precision for
+    the oldest data degrades (tail covers every window)."""
+    store = TpuSpanStore(CFG)
+    expected = set()
+    for i in range(CFG.dep_buckets + 3):
+        p, c = f"svc{i}p", f"svc{i}c"
+        store.apply(_pair(p, c, 1000 + i, (i + 1) * HOUR))
+        store.archive_now()
+        expected.add((p, c))
+    assert _links(store.get_dependencies()) == expected
+    # A recent bucket still answers precisely.
+    last = CFG.dep_buckets + 2
+    recent = store.get_dependencies((last + 1) * HOUR,
+                                    (last + 2) * HOUR - 1)
+    assert (f"svc{last}p", f"svc{last}c") in _links(recent)
+
+
+def test_sharded_dependencies_window():
+    from jax.sharding import Mesh
+
+    from zipkin_tpu.parallel.shard import ShardedSpanStore
+
+    n = min(8, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("shard",))
+    store = ShardedSpanStore(mesh, CFG)
+    store.apply(_pair("alpha", "beta", 100, 1 * HOUR))
+    assert _links(store.get_dependencies(1 * HOUR, 2 * HOUR)) == {
+        ("alpha", "beta")
+    }
+    assert _links(store.get_dependencies(5 * HOUR, 6 * HOUR)) == set()
+
+
+def test_api_dependencies_window_route():
+    from zipkin_tpu.api.server import ApiServer
+    from zipkin_tpu.query.service import QueryService
+
+    store = TpuSpanStore(CFG)
+    store.apply(_pair("alpha", "beta", 100, 1 * HOUR))
+    store.archive_now()
+    store.apply(_pair("gamma", "delta", 200, 2 * HOUR))
+    store.archive_now()
+    api = ApiServer(QueryService(store))
+    status, body = api.handle(
+        "GET", f"/api/dependencies/{1 * HOUR}/{2 * HOUR - 1}", {}
+    )
+    assert status == 200
+    assert {(l["parent"], l["child"]) for l in body["links"]} == {
+        ("alpha", "beta")
+    }
+    status, body = api.handle(
+        "GET", "/api/dependencies",
+        {"startTime": str(2 * HOUR), "endTime": str(3 * HOUR)},
+    )
+    assert status == 200
+    assert {(l["parent"], l["child"]) for l in body["links"]} == {
+        ("gamma", "delta")
+    }
+
+
+def test_sql_dependencies_window():
+    from zipkin_tpu.store.sql import SqliteSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    store = SqliteSpanStore()
+    store.apply(_pair("alpha", "beta", 100, 1 * HOUR))
+    store.aggregate_dependencies()
+    store.apply(_pair("gamma", "delta", 200, 2 * HOUR))
+    store.aggregate_dependencies()
+    assert _links(store.get_dependencies()) == {
+        ("alpha", "beta"), ("gamma", "delta")
+    }
+    assert _links(store.get_dependencies(1 * HOUR, 2 * HOUR - 1)) == {
+        ("alpha", "beta")
+    }
+    assert _links(
+        store.get_dependencies(start_ts=2 * HOUR)
+    ) == {("gamma", "delta")}
+    store.close()

@@ -270,3 +270,81 @@ class TestRingEviction:
         link = {(l.parent, l.child): l for l in deps.links}[("s", "d")]
         assert link.duration_moments.count == 32  # aggregates never evict
         assert store.counters()["spans_seen"] == 64
+
+
+# -- pinned-trace retention (SpanStore.scala:66, web pin Handlers.scala:490)
+
+
+def _mk_span(tid, sid, ts, svc="pinned-svc"):
+    ep = Endpoint(1, 80, svc)
+    return Span(tid, "op", sid, None,
+                (Annotation(ts, "sr", ep), Annotation(ts + 5, "custom", ep)),
+                ())
+
+
+def _flood(store, n_spans, base_sid=10_000):
+    ep = Endpoint(2, 80, "noise")
+    chunk = []
+    for i in range(n_spans):
+        chunk.append(Span(
+            5_000_000 + i, "noise-op", base_sid + i, None,
+            (Annotation(50 + i, "sr", ep),), (),
+        ))
+        if len(chunk) == 256:
+            store.apply(chunk)
+            chunk = []
+    if chunk:
+        store.apply(chunk)
+
+
+def test_pinned_trace_survives_ring_eviction():
+    store = small_store()
+    tid = 424242
+    spans = [_mk_span(tid, s, ts) for s, ts in ((1, 10), (2, 20), (3, 30))]
+    store.apply(spans)
+    store.set_time_to_live(tid, 30 * 24 * 3600.0)
+    # Post-pin arrival must be banked too.
+    store.apply([_mk_span(tid, 4, 40)])
+    # Lap the ring twice: every unpinned row is overwritten.
+    _flood(store, 2 * SMALL.capacity)
+    got = store.get_spans_by_trace_id(tid)
+    assert sorted(s.id for s in got) == [1, 2, 3, 4]
+    assert tid in store.traces_exist([tid])
+    durs = store.get_traces_duration([tid])
+    assert durs and durs[0].duration == 45 - 10
+    # is_pinned truthfulness: the TTL number AND the data both survive.
+    assert store.get_time_to_live(tid) == 30 * 24 * 3600.0
+
+
+def test_unpin_restores_normal_eviction():
+    store = small_store()
+    tid = 515151
+    store.apply([_mk_span(tid, 1, 10)])
+    store.set_time_to_live(tid, 30 * 24 * 3600.0)
+    store.set_time_to_live(tid, 1.0)  # unpin
+    _flood(store, 2 * SMALL.capacity)
+    assert store.get_spans_by_trace_id(tid) == []
+    assert store.traces_exist([tid]) == set()
+
+
+def test_sharded_pinned_trace_survives_eviction():
+    import jax
+    from jax.sharding import Mesh
+
+    from zipkin_tpu.parallel.shard import ShardedSpanStore
+
+    n = min(8, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("shard",))
+    cfg = StoreConfig(
+        capacity=128, ann_capacity=512, bann_capacity=256,
+        max_services=16, max_span_names=32, max_annotation_values=64,
+        max_binary_keys=16, cms_width=256, hll_p=6, quantile_buckets=128,
+    )
+    store = ShardedSpanStore(mesh, cfg)
+    tid = 909090
+    store.apply([_mk_span(tid, 1, 10), _mk_span(tid, 2, 20)])
+    store.set_time_to_live(tid, 30 * 24 * 3600.0)
+    _flood(store, 2 * n * cfg.capacity)
+    got = store.get_spans_by_trace_id(tid)
+    assert sorted(s.id for s in got) == [1, 2]
+    assert tid in store.traces_exist([tid])

@@ -103,7 +103,7 @@ class ApiServer:
             return 200, self.query.get_top_key_value_annotations(
                 _require(params, "serviceName"))
         if path == "/api/dependencies" or re.match(r"^/api/dependencies/", path):
-            return self._dependencies(path)
+            return self._dependencies(path, params)
         m = re.match(r"^/api/(?:trace|get)/(-?\d+)$", path)
         if m:
             return self._trace(int(m.group(1)), params)
@@ -166,8 +166,24 @@ class ApiServer:
             raise KeyError(trace_id)
         return 200, _trace_json(traces[0])
 
-    def _dependencies(self, path):
-        deps = self.query.get_dependencies()
+    def _dependencies(self, path, params):
+        """Optionally windowed: /api/dependencies/<startTs>/<endTs> or
+        ?startTime=&endTime= (µs) — Aggregates.getDependencies(start,
+        end), web route parity with /api/dependencies (Main.scala:85)."""
+        m = re.match(r"^/api/dependencies/(-?\d+)(?:/(-?\d+))?$", path)
+        start_ts = end_ts = None
+        if m:
+            start_ts = int(m.group(1))
+            end_ts = int(m.group(2)) if m.group(2) else None
+        for key, val in (("startTime", "start"), ("endTime", "end"),
+                         ("startTs", "start"), ("endTs", "end")):
+            raw = params.get(key)
+            if raw is not None:
+                if val == "start":
+                    start_ts = int(raw)
+                else:
+                    end_ts = int(raw)
+        deps = self.query.get_dependencies(start_ts, end_ts)
         return 200, {
             "startTime": deps.start_time,
             "endTime": deps.end_time,

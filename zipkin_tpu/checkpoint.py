@@ -29,7 +29,7 @@ from zipkin_tpu.store.tpu import TpuSpanStore
 _STATE_FILE = "state.npz"
 _META_FILE = "meta.json"
 # Bump when the StoreState schema changes in a way load() must adapt to.
-_REVISION = 2
+_REVISION = 3
 
 
 def _dict_dump(d) -> list:
@@ -159,6 +159,16 @@ def load(path: str) -> TpuSpanStore:
         upd["dep_archived_gid"] = jax.numpy.asarray(
             np.int64(data["write_pos"])
         )
+    if "dep_banks" not in upd:
+        # Pre-revision-3 snapshot (single archive bank, no time tags):
+        # the saved dep_moments becomes the all-time tail. Its ts range
+        # is unknown, so mark the tail as covering every window (a zero
+        # bank contributes nothing either way); the bucket ring starts
+        # empty at the init_state defaults.
+        if float(np.asarray(data["dep_moments"])[:, 0].sum()) > 0:
+            upd["dep_overflow_ts"] = jax.numpy.asarray(
+                np.array([dev.I64_MIN, dev.I64_MAX], np.int64)
+            )
     with store._rw.write():
         store.state = store.state.replace(**upd)
     # Re-seed the host mirrors that drive the dependency-archive policy.

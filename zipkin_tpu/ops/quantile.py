@@ -133,3 +133,26 @@ def quantile(sketch: LogHistogram, q):
 
 def count(sketch: LogHistogram):
     return sketch.counts.sum(axis=-1)
+
+
+def quantiles_host(counts, gamma: float, min_value: float, qs):
+    """Pure-numpy twin of ``quantile`` for a single already-fetched
+    [n_buckets] row — serving layers call this on host data; eager jnp
+    here would bounce the row back through the device per quantile."""
+    import numpy as np
+
+    counts = np.asarray(counts, np.float64)
+    total = float(counts.sum())
+    if total <= 0:
+        return [float("nan")] * len(qs)
+    cum = np.cumsum(counts)
+    out = []
+    for q in qs:
+        rank = q * max(total - 1.0, 0.0)
+        b = min(int(np.searchsorted(cum, rank, side="right")),
+                len(counts) - 1)
+        mid = min_value if b == 0 else (
+            min_value * gamma**b * (2.0 / (1.0 + gamma))
+        )
+        out.append(float(mid))
+    return out

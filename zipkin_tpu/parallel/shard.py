@@ -50,6 +50,8 @@ def _summarize(state: dev.StoreState, axis: str) -> Dict[str, jnp.ndarray]:
         "ann_svc_counts": ann_svc_counts,
         "hll_traces": hll_regs,
         "dep_moments": dep_moments,
+        "ts_min": jax.lax.pmin(state.ts_min, axis),
+        "ts_max": jax.lax.pmax(state.ts_max, axis),
     }
 
 
@@ -155,3 +157,553 @@ def global_summary(states, mesh: Mesh, axis: str = "shard"):
 def stack_batches(batches) -> Tuple:
     """Host: list of n DeviceBatch → stacked pytree [n, ...]."""
     return jax.tree.map(lambda *xs: np.stack(xs), *batches)
+
+
+# ---------------------------------------------------------------------------
+# ShardedSpanStore — the full SpanStore SPI over the mesh
+# ---------------------------------------------------------------------------
+
+
+class ShardedSpanStore:
+    """SpanStore SPI over an n-shard device mesh.
+
+    Writes route whole traces to shards by trace-id hash (the role of
+    Cassandra's key-range sharding / BucketedColumnFamily hot-row
+    buckets, CassieSpanStore.scala:49,108-116), so every trace is
+    resident on exactly one shard and trace-local reads stay local.
+    Reads run the single-store query kernels per shard under shard_map
+    and merge across shards: elementwise collectives in-graph where the
+    merge is min/max/sum (durations, presence, sketches), or a host
+    merge of the per-shard top-k candidates for index queries — the
+    batched-cluster-read role of CassieSpanStore.scala:253-270.
+
+    Implements the same surface the conformance suite drives against the
+    in-memory and single-device stores (SpanStoreValidator.scala:27).
+    """
+
+    GATHER_K0 = 4096
+
+    def __init__(self, mesh: Mesh, config: dev.StoreConfig,
+                 axis: str = "shard", codec=None):
+        import threading
+
+        from zipkin_tpu.columnar.encode import SpanCodec
+        from zipkin_tpu.concurrency import RWLock
+        from zipkin_tpu.store.base import PinBank
+
+        self.mesh = mesh
+        self.axis = axis
+        self.config = config
+        self.inner = ShardedStore(mesh, config, axis)
+        self.n = mesh.shape[axis]
+        self.codec = codec or SpanCodec()
+        self.ttls: Dict[int, float] = {}
+        self.pins = PinBank()
+        self._name_lc: Dict[int, int] = {}
+        self._kernels: Dict = {}
+        # Same discipline as TpuSpanStore: _lock serializes writers and
+        # host dicts; the RWLock guards the states swap (sharded ingest
+        # donates the previous stacked states) against in-flight reads.
+        self._lock = threading.Lock()
+        self._rw = RWLock()
+
+    @property
+    def dicts(self):
+        return self.codec.dicts
+
+    @property
+    def states(self):
+        return self.inner.states
+
+    def close(self) -> None:
+        pass
+
+    # -- writes ---------------------------------------------------------
+
+    def _shard_of(self, trace_id: int) -> int:
+        from zipkin_tpu.columnar.encode import to_signed64
+
+        return (to_signed64(trace_id) * 0x9E3779B97F4A7C15) % self.n
+
+    def apply(self, spans) -> None:
+        from zipkin_tpu.columnar.encode import to_signed64
+
+        from zipkin_tpu.store.base import prune_ttls
+        from zipkin_tpu.store.tpu import TpuSpanStore
+
+        if not spans:
+            return
+        with self._lock:
+            for s in spans:
+                self.ttls.setdefault(to_signed64(s.trace_id), 1.0)
+            prune_ttls(self.ttls, TpuSpanStore.MAX_TTL_ENTRIES)
+            self.pins.note_write(to_signed64, spans)
+            self._apply_locked(list(spans))
+
+    def _apply_locked(self, spans) -> None:
+        from zipkin_tpu.store.base import should_index
+        from zipkin_tpu.store.tpu import _next_pow2, name_lc_ids
+
+        groups = [[] for _ in range(self.n)]
+        for s in spans:
+            groups[self._shard_of(s.trace_id)].append(s)
+        # One launch per shard must fit every ring (span AND annotation):
+        # colliding slot scatters within a launch are implementation-
+        # defined (see TpuSpanStore._chunk_columnar). Split-and-retry;
+        # a single span fatter than an annotation ring gets truncated.
+        c = self.config
+        cap = max(1, c.capacity // 2)
+
+        def oversized(g):
+            return (len(g) > cap
+                    or sum(len(s.annotations) for s in g) > c.ann_capacity
+                    or sum(len(s.binary_annotations) for s in g)
+                    > c.bann_capacity)
+
+        if any(oversized(g) for g in groups):
+            if len(spans) > 1:
+                mid = len(spans) // 2
+                self._apply_locked(spans[:mid])
+                self._apply_locked(spans[mid:])
+                return
+            import dataclasses
+
+            s = spans[0]
+            spans = [dataclasses.replace(
+                s,
+                annotations=tuple(s.annotations[:c.ann_capacity]),
+                binary_annotations=tuple(
+                    s.binary_annotations[:c.bann_capacity]
+                ),
+            )]
+            groups = [[] for _ in range(self.n)]
+            groups[self._shard_of(s.trace_id)] = spans
+        dbs = []
+        batches = [self.codec.encode(g) for g in groups]
+        pad_s = _next_pow2(max(b.n_spans for b in batches))
+        pad_a = _next_pow2(max(b.n_annotations for b in batches))
+        pad_b = _next_pow2(max(b.n_binary for b in batches))
+        for g, batch in zip(groups, batches):
+            indexable = np.fromiter(
+                (should_index(s) for s in g), bool, len(g)
+            )
+            lc = name_lc_ids(batch, self.dicts, self._name_lc)
+            dbs.append(dev.make_device_batch(
+                batch, lc, indexable,
+                pad_spans=pad_s, pad_anns=pad_a, pad_banns=pad_b,
+            ))
+        stacked = jax.device_put(
+            stack_batches(dbs), NamedSharding(self.mesh, P(self.axis))
+        )
+        with self._rw.write():
+            self.inner.ingest(stacked)
+
+    DEFAULT_TTL_S = 1.0
+
+    def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
+        from zipkin_tpu.columnar.encode import to_signed64
+        from zipkin_tpu.store.base import fill_pin
+
+        tid = to_signed64(trace_id)
+        with self._lock:
+            self.ttls[tid] = ttl_seconds
+            pin = ttl_seconds > self.DEFAULT_TTL_S
+            if not pin:
+                self.pins.unpin(tid)
+        if pin:
+            fill_pin(self.pins, self._lock, tid, lambda: (
+                self.get_spans_by_trace_ids([trace_id]) or [[]])[0])
+
+    def get_time_to_live(self, trace_id: int) -> float:
+        from zipkin_tpu.columnar.encode import to_signed64
+
+        with self._lock:
+            return self.ttls[to_signed64(trace_id)]
+
+    # -- mapped query kernels (cached per static shape) ------------------
+
+    def _kernel(self, key, build):
+        fn = self._kernels.get(key)
+        if fn is None:
+            fn = build()
+            self._kernels[key] = fn
+        return fn
+
+    def _unstack(self, state):
+        return jax.tree.map(lambda x: x[0], state)
+
+    def _q_by_service(self, limit: int):
+        def build():
+            def fn(state, svc, name_lc, end_ts):
+                st = self._unstack(state)
+                mat = dev.query_trace_ids_by_service.__wrapped__(
+                    st, svc, name_lc, end_ts, limit
+                )
+                return mat[None]
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(self.axis), P(), P(), P()),
+                out_specs=P(self.axis), check_vma=False,
+            ))
+
+        return self._kernel(("svc", limit), build)
+
+    def _q_by_annotation(self, limit: int):
+        def build():
+            def fn(state, svc, ann, bkey, bval, bval2, end_ts):
+                st = self._unstack(state)
+                mat = dev.query_trace_ids_by_annotation.__wrapped__(
+                    st, svc, ann, bkey, bval, bval2, end_ts, limit
+                )
+                return mat[None]
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(self.axis),) + (P(),) * 6,
+                out_specs=P(self.axis), check_vma=False,
+            ))
+
+        return self._kernel(("ann", limit), build)
+
+    def _q_durations(self):
+        def build():
+            def fn(state, qids):
+                st = self._unstack(state)
+                mat = dev.query_durations.__wrapped__(st, qids)
+                return jnp.stack([
+                    jax.lax.pmax(mat[0], self.axis),
+                    jax.lax.pmax(mat[1], self.axis),
+                    jax.lax.pmin(mat[2], self.axis),
+                    jax.lax.pmax(mat[3], self.axis),
+                ])
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
+                out_specs=P(), check_vma=False,
+            ))
+
+        return self._kernel(("durations",), build)
+
+    def _q_gather(self, k_s: int, k_a: int, k_b: int):
+        def build():
+            def fn(state, qids):
+                st = self._unstack(state)
+                counts, s, a, b = dev.gather_trace_rows.__wrapped__(
+                    st, qids, k_s, k_a, k_b
+                )
+                return counts[None], s[None], a[None], b[None]
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
+                out_specs=P(self.axis), check_vma=False,
+            ))
+
+        return self._kernel(("gather", k_s, k_a, k_b), build)
+
+    def _cat_kernel(self, key: str):
+        """One small collective per catalog key — all-reducing the whole
+        catalog to read one scalar/row would waste device time on hot
+        paths like the sampler's stored_span_count tick."""
+
+        def build():
+            def fn(state):
+                st = self._unstack(state)
+                if key == "hll_traces":
+                    return jax.lax.pmax(st.hll_traces, self.axis)
+                if key == "spans_seen":
+                    return jax.lax.psum(st.counters["spans_seen"],
+                                        self.axis)
+                return jax.lax.psum(getattr(st, key), self.axis)
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis),),
+                out_specs=P(), check_vma=False,
+            ))
+
+        return self._kernel(("cat", key), build)
+
+    # -- id lookups ------------------------------------------------------
+
+    def _svc_id(self, service_name: str):
+        return self.dicts.services.get(service_name.lower())
+
+    def _merge_topk(self, mats: np.ndarray, limit: int):
+        from zipkin_tpu.store.base import dedup_rank_limit
+
+        return dedup_rank_limit(
+            ((int(t), int(ts))
+             for sh in range(mats.shape[0])
+             for t, ts, v in zip(*mats[sh])
+             if v),
+            limit,
+        )
+
+    def get_trace_ids_by_name(self, service_name, span_name, end_ts, limit):
+        svc = self._svc_id(service_name)
+        if svc is None or limit <= 0:
+            return []
+        if span_name is not None:
+            name_lc = self.dicts.span_names.get(span_name.lower())
+            if name_lc is None:
+                return []
+        else:
+            name_lc = -1
+        with self._rw.read():
+            mats = jax.device_get(self._q_by_service(limit)(
+                self.states, jnp.int32(svc), jnp.int32(name_lc),
+                jnp.int64(end_ts),
+            ))
+        return self._merge_topk(mats, limit)
+
+    def get_trace_ids_by_annotation(self, service_name, annotation, value,
+                                    end_ts, limit):
+        from zipkin_tpu.models.constants import CORE_ANNOTATIONS
+        from zipkin_tpu.store.base import resolve_annotation_query
+
+        if annotation in CORE_ANNOTATIONS or limit <= 0:
+            return []
+        svc = self._svc_id(service_name)
+        if svc is None:
+            return []
+        resolved = resolve_annotation_query(self.dicts, annotation, value)
+        if resolved is None:
+            return []
+        ann_value, bann_key, bann_value, bann_value2 = resolved
+        with self._rw.read():
+            mats = jax.device_get(self._q_by_annotation(limit)(
+                self.states, jnp.int32(svc), jnp.int32(ann_value),
+                jnp.int32(bann_key), jnp.int32(bann_value),
+                jnp.int32(bann_value2), jnp.int64(end_ts),
+            ))
+        return self._merge_topk(mats, limit)
+
+    # -- trace reads -----------------------------------------------------
+
+    def _sorted_qids(self, trace_ids) -> np.ndarray:
+        from zipkin_tpu.columnar.encode import to_signed64
+
+        return np.sort(
+            np.asarray([to_signed64(t) for t in trace_ids], np.int64)
+        )
+
+    def traces_exist(self, trace_ids):
+        from zipkin_tpu.columnar.encode import to_signed64
+
+        if not trace_ids:
+            return set()
+        canon = {to_signed64(t): t for t in trace_ids}
+        qids = self._sorted_qids(trace_ids)
+        with self._rw.read():
+            mat = jax.device_get(self._q_durations()(self.states, qids))
+        out = {canon[int(q)] for q, p in zip(qids, mat[0]) if p}
+        with self._lock:
+            if self.pins:
+                out |= {
+                    orig for stid, orig in canon.items()
+                    if stid in self.pins and self.pins.get(stid)
+                }
+        return out
+
+    def get_traces_duration(self, trace_ids):
+        from zipkin_tpu.columnar.encode import to_signed64
+        from zipkin_tpu.store.base import TraceIdDuration
+        from zipkin_tpu.store.tpu import _pinned_duration
+
+        if not trace_ids:
+            return []
+        canon = {to_signed64(t): t for t in trace_ids}
+        qids = self._sorted_qids(trace_ids)
+        with self._rw.read():
+            mat = jax.device_get(self._q_durations()(self.states, qids))
+        by_tid = {
+            canon[int(q)]: TraceIdDuration(canon[int(q)], int(mx - mn), int(mn))
+            for q, f, mn, mx in zip(qids, mat[1], mat[2], mat[3])
+            if f
+        }
+        with self._lock:
+            if self.pins:
+                for stid, orig in canon.items():
+                    if stid not in self.pins:
+                        continue
+                    d = _pinned_duration(orig, self.pins.get(stid),
+                                         by_tid.get(orig))
+                    if d is not None:
+                        by_tid[orig] = d
+        return [by_tid[t] for t in trace_ids if t in by_tid]
+
+    def get_spans_by_trace_ids(self, trace_ids):
+        from zipkin_tpu.columnar.encode import to_signed64
+        from zipkin_tpu.store.tpu import decode_gathered
+
+        if not trace_ids:
+            return []
+        from zipkin_tpu.store.base import apply_pin_merges, escalate_cap
+
+        qids = self._sorted_qids(trace_ids)
+        c = self.config
+        k_s = min(self.GATHER_K0, c.capacity)
+        k_a = min(2 * self.GATHER_K0, c.ann_capacity)
+        k_b = min(self.GATHER_K0, c.bann_capacity)
+        with self._rw.read():
+            while True:
+                counts, s_m, a_m, b_m = jax.device_get(
+                    self._q_gather(k_s, k_a, k_b)(self.states, qids)
+                )
+                n_s = int(counts[:, 0].max())
+                n_a = int(counts[:, 1].max())
+                n_b = int(counts[:, 2].max())
+                if n_s <= k_s and n_a <= k_a and n_b <= k_b:
+                    break
+                k_s = escalate_cap(n_s, k_s, c.capacity)
+                k_a = escalate_cap(n_a, k_a, c.ann_capacity)
+                k_b = escalate_cap(n_b, k_b, c.bann_capacity)
+        spans = []
+        for sh in range(self.n):
+            spans.extend(decode_gathered(
+                self.codec, int(counts[sh, 0]), int(counts[sh, 1]),
+                int(counts[sh, 2]), s_m[sh], a_m[sh], b_m[sh],
+            ))
+        by_tid: Dict[int, list] = {}
+        for span in spans:
+            by_tid.setdefault(span.trace_id, []).append(span)
+        with self._lock:
+            apply_pin_merges(self.pins, by_tid, trace_ids, to_signed64)
+        return [
+            by_tid[to_signed64(tid)]
+            for tid in trace_ids
+            if to_signed64(tid) in by_tid
+        ]
+
+    def get_spans_by_trace_id(self, trace_id: int):
+        found = self.get_spans_by_trace_ids([trace_id])
+        return found[0] if found else []
+
+    # -- name catalogs / analytics --------------------------------------
+
+    def _cat(self, key, row=None):
+        """Read-locked fetch of one collective catalog entry (optionally
+        one row of it) — a single D2H transfer."""
+        with self._rw.read():
+            entry = self._cat_kernel(key)(self.states)
+            if row is not None:
+                entry = entry[row]
+            return jax.device_get(entry)
+
+    def get_all_service_names(self):
+        present = self._cat("ann_svc_counts") > 0
+        d = self.dicts.services
+        return {
+            d.decode(i) for i in np.flatnonzero(present)
+            if i < len(d) and d.decode(i)
+        }
+
+    def get_span_names(self, service: str):
+        svc = self._svc_id(service)
+        if svc is None:
+            return set()
+        row = self._cat("name_presence", svc) > 0
+        d = self.dicts.span_names
+        return {
+            d.decode(i) for i in np.flatnonzero(row)
+            if i < len(d) and d.decode(i)
+        }
+
+    def _summary_kernel(self):
+        def build():
+            def fn(state):
+                return _summarize(self._unstack(state), self.axis)
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis),),
+                out_specs=P(), check_vma=False,
+            ))
+
+        return self._kernel(("summary",), build)
+
+    def _deps_range_kernel(self):
+        def build():
+            def fn(state, start_ts, end_ts):
+                st = self._unstack(state)
+                bank = dev.dep_moments_in_range(st, start_ts, end_ts)
+                banks = jax.lax.all_gather(bank, self.axis)
+                return M.reduce_moments(banks, axis=0)
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis), P(), P()),
+                out_specs=P(), check_vma=False,
+            ))
+
+        return self._kernel(("deps_range",), build)
+
+    def get_dependencies(self, start_ts=None, end_ts=None):
+        from zipkin_tpu.aggregate.job import dependencies_from_bank
+
+        with self._rw.read():
+            summary = self._summary_kernel()(self.states)
+            if start_ts is None and end_ts is None:
+                bank, ts_min, ts_max = jax.device_get(
+                    (summary["dep_moments"], summary["ts_min"],
+                     summary["ts_max"])
+                )
+            else:
+                s = dev.I64_MIN if start_ts is None else int(start_ts)
+                e = dev.I64_MAX if end_ts is None else int(end_ts)
+                bank = jax.device_get(self._deps_range_kernel()(
+                    self.states, jnp.int64(s), jnp.int64(e)
+                ))
+                ts_min, ts_max = jax.device_get(
+                    (summary["ts_min"], summary["ts_max"])
+                )
+                ts_min, ts_max = max(int(ts_min), s), min(int(ts_max), e)
+        return dependencies_from_bank(
+            bank, self.dicts.services, self.config.max_services,
+            float(ts_min), float(ts_max),
+        )
+
+    def service_duration_quantiles(self, service: str, qs):
+        from zipkin_tpu.ops import quantile as Q
+
+        svc = self._svc_id(service)
+        if svc is None:
+            return None
+        c = self.config
+        gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
+        counts = self._cat("svc_hist", svc)
+        return Q.quantiles_host(counts, gamma, 1.0, qs)
+
+    def top_annotations(self, service: str, k: int = 10):
+        svc = self._svc_id(service)
+        if svc is None:
+            return []
+        row = self._cat("ann_value_counts", svc)
+        order = np.argsort(-row)[:k]
+        d = self.dicts.annotations
+        return [
+            (d.decode(int(i)), int(row[i])) for i in order
+            if row[i] > 0 and i < len(d)
+        ]
+
+    def top_binary_keys(self, service: str, k: int = 10):
+        svc = self._svc_id(service)
+        if svc is None:
+            return []
+        row = self._cat("bann_key_counts", svc)
+        order = np.argsort(-row)[:k]
+        d = self.dicts.binary_keys
+        return [
+            (d.decode(int(i)), int(row[i])) for i in order
+            if row[i] > 0 and i < len(d)
+        ]
+
+    def estimated_unique_traces(self) -> float:
+        from zipkin_tpu.ops import hll
+
+        regs = self._cat("hll_traces")
+        return float(hll.estimate(hll.HyperLogLog(regs)))
+
+    def stored_span_count(self) -> float:
+        """psum-ed spans_seen across every shard — the sharded flow
+        source for the adaptive controller (the ZK group-sum role,
+        AdaptiveSampler.scala:204-237)."""
+        return float(self._cat("spans_seen"))

@@ -182,7 +182,10 @@ class QueryService:
 
     def get_dependencies(self, start_ts: Optional[int] = None,
                          end_ts: Optional[int] = None):
-        """Dependencies from the store's aggregate state (Aggregates.scala:31).
+        """Dependencies from the store's aggregate state, optionally
+        restricted to [start_ts, end_ts]
+        (Aggregates.getDependencies(startDate, endDate),
+        Aggregates.scala:26-31; QueryService.scala:393).
 
         Stores without dependency aggregation (the in-memory reference
         store) behave like NullAggregates and return zero."""
@@ -191,7 +194,7 @@ class QueryService:
         getter = getattr(self.store, "get_dependencies", None)
         if getter is None:
             return Dependencies.zero()
-        return getter()
+        return getter(start_ts, end_ts)
 
     def get_top_annotations(self, service: str, k: int = 10) -> List[str]:
         getter = getattr(self.store, "top_annotations", None)

@@ -63,6 +63,163 @@ def should_index(span: Span) -> bool:
     return not (span.is_client_side() and "client" in span.service_names)
 
 
+class PinBank:
+    """Host-side eviction-exempt storage for pinned traces.
+
+    The reference's setTimeToLive actually extends storage retention
+    (SpanStore.scala:66; web pin → Handlers.scala:490). The device ring
+    evicts by wraparound regardless of TTL, so pinning a trace
+    materializes its spans into this bank at pin time, keeps the bank
+    fresh as later spans of the trace arrive, and trace-id read paths
+    union it with ring results — the pinned trace stays fully readable
+    after the ring has lapped it. Unpinning drops the entry.
+    """
+
+    def __init__(self):
+        self._pins = {}
+
+    def __bool__(self) -> bool:
+        return bool(self._pins)
+
+    def __contains__(self, tid: int) -> bool:
+        return tid in self._pins
+
+    def pin(self, tid: int, spans) -> None:
+        self._pins[tid] = list(spans)
+
+    def unpin(self, tid: int) -> None:
+        self._pins.pop(tid, None)
+
+    def get(self, tid: int):
+        return self._pins.get(tid)
+
+    def tids(self):
+        return set(self._pins)
+
+    def note_write(self, key_of, spans) -> None:
+        """Append incoming spans of already-pinned traces — post-pin
+        arrivals must survive eviction too."""
+        if not self._pins:
+            return
+        for s in spans:
+            bank = self._pins.get(key_of(s.trace_id))
+            if bank is not None:
+                bank.append(s)
+
+    def merge(self, tid: int, ring_spans):
+        """Union bank + ring rows for one trace: bank spans (inserted
+        earlier) first, then ring spans whose span id isn't banked.
+
+        Dedup is by span id, not object equality: a ring row whose
+        annotations were evicted from their own ring decodes as a
+        partial twin of the banked span — every post-pin arrival is
+        banked by note_write, so a ring copy sharing a banked id is
+        redundant (or partial) by construction."""
+        bank = self._pins.get(tid)
+        if not bank:
+            return list(ring_spans)
+        seen_ids = {s.id for s in bank}
+        return list(bank) + [s for s in ring_spans if s.id not in seen_ids]
+
+
+def prune_ttls(ttls: dict, max_entries: int) -> None:
+    """Drop oldest non-pinned TTL entries beyond the bound (ring
+    eviction is the real retention; pinned entries — ttl > 1.0 —
+    survive). Shared by the single-device and sharded stores."""
+    excess = len(ttls) - max_entries
+    if excess <= 0:
+        return
+    for tid in list(ttls):
+        if excess <= 0:
+            break
+        if ttls[tid] <= 1.0:
+            del ttls[tid]
+            excess -= 1
+
+
+def fill_pin(pins: PinBank, lock, tid: int, fetch_spans) -> None:
+    """Pin-materialization with the TOCTOU window closed: open the bank
+    under ``lock`` FIRST (so concurrent writes bank their arrivals via
+    note_write), then fetch the ring snapshot outside the lock, then
+    union both under the lock."""
+    with lock:
+        if tid in pins:
+            return
+        pins.pin(tid, [])
+    found = fetch_spans()
+    with lock:
+        banked = pins.get(tid)
+        if banked is None:  # unpinned while fetching
+            return
+        seen = set(banked)
+        pins.pin(tid, list(banked) + [s for s in found if s not in seen])
+
+
+def resolve_annotation_query(dicts, annotation: str, value):
+    """Dictionary-id resolution for get_trace_ids_by_annotation, shared
+    by the single-device and sharded stores. Returns
+    (ann_value, bann_key, bann_value, bann_value2) with -1 sentinels,
+    or None when nothing in the dictionaries can match."""
+    bann_key = dicts.binary_keys.get(annotation)
+    bann_key = -1 if bann_key is None else bann_key
+    if value is not None:
+        # Value given: only binary annotations with that exact value
+        # match. The dictionary keys values in their original python
+        # form, so probe both the bytes and the decoded-str shape.
+        ann_value = -1
+        vb = as_bytes(value)
+        bann_value = dicts.binary_values.get(vb)
+        try:
+            bann_value2 = dicts.binary_values.get(vb.decode("utf-8"))
+        except UnicodeDecodeError:
+            bann_value2 = None
+        bann_value = -1 if bann_value is None else bann_value
+        bann_value2 = -1 if bann_value2 is None else bann_value2
+        if (bann_value < 0 and bann_value2 < 0) or bann_key < 0:
+            return None
+    else:
+        ann_value = dicts.annotations.get(annotation)
+        ann_value = -1 if ann_value is None else ann_value
+        bann_value = bann_value2 = -1
+        if ann_value < 0 and bann_key < 0:
+            return None
+    return ann_value, bann_key, bann_value, bann_value2
+
+
+def dedup_rank_limit(candidates, limit: int) -> List["IndexedTraceId"]:
+    """One IndexedTraceId per trace id (max timestamp wins), sorted by
+    timestamp descending, truncated to ``limit`` — the dedup-before-limit
+    semantics every store's index queries share."""
+    best = {}
+    for tid, ts in candidates:
+        if ts > best.get(tid, -1):
+            best[tid] = ts
+    ranked = sorted(best.items(), key=lambda kv: kv[1], reverse=True)
+    return [IndexedTraceId(t, ts) for t, ts in ranked[:limit]]
+
+
+def apply_pin_merges(pins: PinBank, by_tid: dict, trace_ids, key_of) -> None:
+    """Union each requested pinned trace's bank into ``by_tid`` in place.
+    Callers hold whatever lock guards ``pins``."""
+    if not pins:
+        return
+    for tid in trace_ids:
+        stid = key_of(tid)
+        if stid in pins:
+            merged = pins.merge(stid, by_tid.get(stid, []))
+            if merged:
+                by_tid[stid] = merged
+
+
+def escalate_cap(n: int, k: int, cap: int) -> int:
+    """Grow a static gather cap ×8 until it covers ``n`` (bounded by the
+    ring capacity) — shared by the single-store and sharded trace reads
+    so their compile-cache keys stay aligned."""
+    while n > k:
+        k = min(k * 8, cap)
+    return k
+
+
 class WriteSpanStore(abc.ABC):
     @abc.abstractmethod
     def apply(self, spans: Sequence[Span]) -> None:

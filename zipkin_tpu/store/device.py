@@ -61,6 +61,13 @@ class StoreConfig(NamedTuple):
     # silently clip long durations into the top bucket.
     quantile_buckets: int = 2048
     quantile_alpha: float = 0.01
+    # Ring of time-tagged dependency-link archive banks: each archive
+    # pass lands in its own [S*S, 5] bank stamped with the joined
+    # children's ts range, so get_dependencies(start, end) can answer a
+    # window (Aggregates.getDependencies(startDate, endDate),
+    # Aggregates.scala:26-31). Banks older than the ring merge into a
+    # tail bank (all-time totals never regress).
+    dep_buckets: int = 16
     # Route ingest scatter-adds through the VMEM-resident pallas
     # histogram kernels (ops/pallas_kernels.py) instead of XLA scatter.
     # Benchmarked on the real chip by bench.py --compare-kernels; arrays
@@ -116,15 +123,24 @@ class StoreState:
     bann_write_pos: jnp.ndarray
 
     # -- streaming aggregate state (never evicted) ----------------------
-    # Dependency links use an eviction-watermark archive: dep_moments
-    # holds links whose CHILD row gid < dep_archived_gid, folded in by
-    # dep_archive_step just before those rows near eviction (joined
-    # against the full resident ring, so parent/child halves arriving in
-    # different batches still link — ADVICE r1: a within-batch-only join
-    # systematically undercounts vs ZipkinAggregateJob). Links of newer
-    # children are computed on demand by live_dep_moments; the two are
-    # disjoint by construction, so total = combine(archive, live).
-    dep_moments: jnp.ndarray  # [S*S, 5] f32 — archived DependencyLink moments
+    # Dependency links use an eviction-watermark archive: each
+    # dep_archive_step folds links whose CHILD row gid crosses the
+    # watermark into a time-tagged bank (joined against the full
+    # resident ring, so parent/child halves arriving in different
+    # batches still link — ADVICE r1: a within-batch-only join
+    # systematically undercounts vs ZipkinAggregateJob). The K most
+    # recent archive passes each keep their own bank in ``dep_banks``
+    # stamped with the children's ts range in ``dep_bank_ts`` (the
+    # hourly-Dependencies-rows role, Dependencies.scala:59-67); on slot
+    # reuse the displaced bank merges into the all-time tail
+    # ``dep_moments``. Links of unarchived children are computed on
+    # demand by live_dep_moments; all parts are disjoint, so
+    # total = combine(tail, banks, live).
+    dep_moments: jnp.ndarray  # [S*S, 5] f32 — tail (pre-ring) link moments
+    dep_banks: jnp.ndarray  # [K, S*S, 5] f32 — time-tagged archive ring
+    dep_bank_ts: jnp.ndarray  # [K, 2] i64 — (min first_ts, max last_ts)
+    dep_overflow_ts: jnp.ndarray  # [2] i64 — ts range of the tail bank
+    dep_bank_seq: jnp.ndarray  # scalar i64 — next archive slot
     dep_archived_gid: jnp.ndarray  # scalar i64 — archive watermark
     svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
     svc_span_counts: jnp.ndarray  # [S] f32
@@ -146,7 +162,8 @@ class StoreState:
         "ann_endpoint_id", "ann_write_pos",
         "bann_gid", "bann_key_id", "bann_value_id", "bann_type",
         "bann_service_id", "bann_endpoint_id", "bann_write_pos",
-        "dep_moments", "dep_archived_gid", "svc_hist", "svc_span_counts",
+        "dep_moments", "dep_banks", "dep_bank_ts", "dep_overflow_ts",
+        "dep_bank_seq", "dep_archived_gid", "svc_hist", "svc_span_counts",
         "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
         "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
@@ -203,6 +220,12 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         # exact to 2.1e9 and psum-able. Only the Moments bank stays f32
         # (its combine adds batch-sized increments, not +1s).
         dep_moments=jnp.zeros((S * S, M.N_FIELDS), jnp.float32),
+        dep_banks=jnp.zeros((c.dep_buckets, S * S, M.N_FIELDS), jnp.float32),
+        dep_bank_ts=jnp.tile(
+            jnp.array([[I64_MAX, I64_MIN]], jnp.int64), (c.dep_buckets, 1)
+        ),
+        dep_overflow_ts=jnp.array([I64_MAX, I64_MIN], jnp.int64),
+        dep_bank_seq=jnp.int64(0),
         dep_archived_gid=jnp.int64(0),
         svc_hist=Q.init(
             shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
@@ -407,7 +430,7 @@ def _ring_children(state: "StoreState"):
 @jax.jit
 def dep_archive_step(state: "StoreState", w_new) -> "StoreState":
     """Fold links of children with archived_gid <= gid < ``w_new`` into
-    the archive bank and advance the watermark.
+    a fresh time-tagged archive bank and advance the watermark.
 
     Children join against the FULL resident ring, so parent and child
     halves that arrived in different payloads (the normal case across
@@ -415,6 +438,10 @@ def dep_archive_step(state: "StoreState", w_new) -> "StoreState":
     ZipkinAggregateJob.scala:26-38 run over a sliding window. Callers
     (TpuSpanStore._maybe_archive) invoke this before unarchived rows can
     be evicted, so every child is joined exactly once.
+
+    The bank lands in archive-ring slot ``dep_bank_seq % K`` stamped
+    with the window children's ts range; the displaced slot's content
+    merges into the all-time tail so totals never regress.
     """
     w_new = jnp.asarray(w_new, jnp.int64)
     live, children = _ring_children(state)
@@ -427,8 +454,37 @@ def dep_archive_step(state: "StoreState", w_new) -> "StoreState":
         state.trace_id, state.span_id, state.parent_id, state.service_id,
         state.duration, live, probe, state.config.max_services,
     )
+    ts_f = jnp.where(probe & (state.ts_first >= 0), state.ts_first,
+                     I64_MAX).min()
+    ts_l = jnp.where(probe & (state.ts_last >= 0), state.ts_last,
+                     I64_MIN).max()
+    # Empty pass (no children in the window — e.g. an idle hourly
+    # timer): only advance the watermark. Rotating would displace one
+    # real time-tagged bank per idle tick into the untagged tail and
+    # erode the windowing.
+    rotate = probe.any()
+    K = state.config.dep_buckets
+    slot = (state.dep_bank_seq % K).astype(jnp.int32)
+    displaced = state.dep_banks[slot]
+    displaced_ts = state.dep_bank_ts[slot]
     return state.replace(
-        dep_moments=M.combine(state.dep_moments, bank),
+        dep_moments=jnp.where(
+            rotate, M.combine(state.dep_moments, displaced),
+            state.dep_moments,
+        ),
+        dep_overflow_ts=jnp.where(rotate, jnp.stack([
+            jnp.minimum(state.dep_overflow_ts[0], displaced_ts[0]),
+            jnp.maximum(state.dep_overflow_ts[1], displaced_ts[1]),
+        ]), state.dep_overflow_ts),
+        dep_banks=jnp.where(
+            rotate, state.dep_banks.at[slot].set(bank), state.dep_banks
+        ),
+        dep_bank_ts=jnp.where(
+            rotate,
+            state.dep_bank_ts.at[slot].set(jnp.stack([ts_f, ts_l])),
+            state.dep_bank_ts,
+        ),
+        dep_bank_seq=state.dep_bank_seq + rotate.astype(jnp.int64),
         dep_archived_gid=jnp.maximum(state.dep_archived_gid, w_new),
     )
 
@@ -461,8 +517,46 @@ def live_dep_moments(state: "StoreState"):
 
 @jax.jit
 def total_dep_moments(state: "StoreState"):
-    """Archive + live: the complete dependency-link Moments bank."""
-    return M.combine(state.dep_moments, live_dep_moments(state))
+    """Tail + time-tagged banks + live: the complete link Moments bank."""
+    banks = M.reduce_moments(state.dep_banks, axis=0)
+    return M.combine(
+        M.combine(state.dep_moments, banks), live_dep_moments(state)
+    )
+
+
+@jax.jit
+def dep_moments_in_range(state: "StoreState", start_ts, end_ts):
+    """Link Moments restricted to archive banks (and the live window)
+    whose children's ts range overlaps [start_ts, end_ts] — the
+    device answer to Aggregates.getDependencies(startDate, endDate)
+    (Aggregates.scala:26-31). Bucket-granular: a bank overlapping the
+    window contributes whole (the reference's hourly Dependencies rows
+    are equally coarse, Dependencies.scala:59-67)."""
+    start_ts = jnp.asarray(start_ts, jnp.int64)
+    end_ts = jnp.asarray(end_ts, jnp.int64)
+    bmin = state.dep_bank_ts[:, 0]
+    bmax = state.dep_bank_ts[:, 1]
+    sel = (bmin <= end_ts) & (bmax >= start_ts)
+    banks = jnp.where(sel[:, None, None], state.dep_banks, 0.0)
+    total = M.reduce_moments(banks, axis=0)
+    ov = (
+        (state.dep_overflow_ts[0] <= end_ts)
+        & (state.dep_overflow_ts[1] >= start_ts)
+    )
+    total = M.combine(total, jnp.where(ov, state.dep_moments, 0.0))
+    # Live (unarchived) children: include when their ts range overlaps.
+    live, children = _ring_children(state)
+    probe = children & (state.row_gid >= state.dep_archived_gid)
+    l_min = jnp.where(probe & (state.ts_first >= 0), state.ts_first,
+                      I64_MAX).min()
+    l_max = jnp.where(probe & (state.ts_last >= 0), state.ts_last,
+                      I64_MIN).max()
+    l_ok = (l_min <= end_ts) & (l_max >= start_ts)
+    live_bank = dep_link_moments(
+        state.trace_id, state.span_id, state.parent_id, state.service_id,
+        state.duration, live, probe, state.config.max_services,
+    )
+    return M.combine(total, jnp.where(l_ok, live_bank, 0.0))
 
 
 # ---------------------------------------------------------------------------
@@ -683,6 +777,10 @@ def query_trace_ids_by_service(
 
     Reference semantics: getTraceIdsByName (SpanStore.scala /
     CassieSpanStore.scala:366) with index ts = span last timestamp.
+
+    Returns ONE stacked [3, limit] i64 array (tids, tss, valid) — host
+    transfers through the tunnel pay a large per-array latency, so query
+    results cross as a single array.
     """
     slot, live = _ann_span_slot(state)
     ok = live & (state.ann_service_id == svc_id)
@@ -690,7 +788,8 @@ def query_trace_ids_by_service(
     ok &= (name_lc_id < 0) | (state.name_lc_id[slot] == name_lc_id)
     ts = state.ts_last[slot]
     ok &= (ts >= 0) & (ts <= end_ts)
-    return _dedup_topk_by_ts(state.trace_id[slot], ts, ok, limit)
+    tids, tss, valid = _dedup_topk_by_ts(state.trace_id[slot], ts, ok, limit)
+    return jnp.stack([tids, tss, valid.astype(jnp.int64)])
 
 
 @partial(jax.jit, static_argnums=(7,))
@@ -738,7 +837,8 @@ def query_trace_ids_by_annotation(
     tid = jnp.concatenate([state.trace_id[a_slot], state.trace_id[b_slot]])
     ts = jnp.concatenate([a_ts, b_ts])
     ok = jnp.concatenate([a_ok, b_ok])
-    return _dedup_topk_by_ts(tid, ts, ok, limit)
+    tids, tss, valid = _dedup_topk_by_ts(tid, ts, ok, limit)
+    return jnp.stack([tids, tss, valid.astype(jnp.int64)])
 
 
 def _span_has_service(state: StoreState, span_slot, svc_id):
@@ -757,10 +857,13 @@ def _span_has_service(state: StoreState, span_slot, svc_id):
 
 @jax.jit
 def query_durations(state: StoreState, sorted_qids):
-    """Per queried trace id: (found, min first_ts, max last_ts).
+    """Per queried trace id, ONE stacked [4, nq] i64 array:
+    (present, found, min first_ts, max last_ts).
 
-    ``sorted_qids`` must be ascending (host sorts). Mirrors
-    getTracesDuration (Index.scala:26): duration = max(last) - min(first).
+    ``present`` = any live row carries the id (traces_exist semantics);
+    ``found`` additionally requires a timestamp (getTracesDuration,
+    Index.scala:26: duration = max(last) - min(first)). ``sorted_qids``
+    must be ascending (host sorts).
     """
     nq = sorted_qids.shape[0]
     live = state.row_gid >= 0
@@ -780,7 +883,79 @@ def query_durations(state: StoreState, sorted_qids):
     found = (
         jnp.zeros(nq + 1, bool).at[seg].max(has_ts, mode="drop")[:nq]
     )
-    return found, min_first, max_last
+    present = (
+        jnp.zeros(nq + 1, bool).at[seg].max(match, mode="drop")[:nq]
+    )
+    return jnp.stack([
+        present.astype(jnp.int64), found.astype(jnp.int64), min_first, max_last
+    ])
+
+
+# Column order of the stacked matrices gather_trace_rows returns; the
+# host decodes by these names (row_gid last in SPAN_MAT_COLS).
+SPAN_MAT_COLS = (
+    "trace_id", "span_id", "parent_id", "name_id", "service_id",
+    "ts_cs", "ts_cr", "ts_sr", "ts_ss", "ts_first", "ts_last",
+    "duration", "flags", "row_gid",
+)
+ANN_MAT_COLS = ("ann_gid", "ann_ts", "ann_value_id", "ann_service_id",
+                "ann_endpoint_id")
+BANN_MAT_COLS = ("bann_gid", "bann_key_id", "bann_value_id", "bann_type",
+                 "bann_service_id", "bann_endpoint_id")
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def gather_trace_rows(
+    state: StoreState, sorted_qids, k_spans: int, k_anns: int, k_banns: int,
+):
+    """Device-side gather of every ring row belonging to ``sorted_qids``,
+    compacted to the front in insertion order, returned as THREE stacked
+    i64 matrices plus a [3] count vector — four arrays total, because
+    host transfers pay a large per-array latency and the naive path
+    (pull whole ring columns, mask on host) moves the entire store
+    through the tunnel per trace read.
+
+    Span rows sort by global row id (insertion order); annotation rows
+    by ring age so per-span annotation insert order survives. Rows
+    beyond the static ``k_*`` caps are dropped — counts tell the caller
+    to escalate caps and retry (the maxTraceCols-style guard,
+    CassieSpanStore.scala:50).
+    """
+    span_in, ann_in, bann_in = query_trace_membership(state, sorted_qids)
+    c = state.config
+
+    key = jnp.where(span_in, state.row_gid, I64_MAX)
+    sel = jnp.argsort(key)[:k_spans]
+    span_mat = jnp.stack(
+        [getattr(state, col)[sel].astype(jnp.int64) for col in SPAN_MAT_COLS]
+    )
+
+    a_head = (state.ann_write_pos % c.ann_capacity).astype(jnp.int32)
+    a_slots = jnp.arange(c.ann_capacity, dtype=jnp.int32)
+    a_age = (a_slots - a_head) % c.ann_capacity
+    a_sel = jnp.argsort(jnp.where(ann_in, a_age, np.int32(2**31 - 1)))[:k_anns]
+    ann_mat = jnp.stack(
+        [getattr(state, col)[a_sel].astype(jnp.int64) for col in ANN_MAT_COLS]
+    )
+    # Mask stale selections (when fewer than k_anns match).
+    ann_mat = jnp.where(ann_in[a_sel][None, :], ann_mat, -1)
+
+    b_head = (state.bann_write_pos % c.bann_capacity).astype(jnp.int32)
+    b_slots = jnp.arange(c.bann_capacity, dtype=jnp.int32)
+    b_age = (b_slots - b_head) % c.bann_capacity
+    b_sel = jnp.argsort(jnp.where(bann_in, b_age, np.int32(2**31 - 1)))[:k_banns]
+    bann_mat = jnp.stack(
+        [getattr(state, col)[b_sel].astype(jnp.int64)
+         for col in BANN_MAT_COLS]
+    )
+    bann_mat = jnp.where(bann_in[b_sel][None, :], bann_mat, -1)
+
+    counts = jnp.stack([
+        span_in.sum(dtype=jnp.int64),
+        ann_in.sum(dtype=jnp.int64),
+        bann_in.sum(dtype=jnp.int64),
+    ])
+    return counts, span_mat, ann_mat, bann_mat
 
 
 @jax.jit

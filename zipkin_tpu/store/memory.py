@@ -159,12 +159,11 @@ class InMemorySpanStore(SpanStore):
 
 
 def _dedup_limit(matched: List[Span], limit: int) -> List[IndexedTraceId]:
-    """One IndexedTraceId per trace (max last_timestamp), ts desc, limit."""
-    best: Dict[int, int] = {}
-    for s in matched:
-        ts = s.last_timestamp
-        if ts is not None and ts > best.get(s.trace_id, -1):
-            best[s.trace_id] = ts
-    ranked = sorted(best.items(), key=lambda kv: kv[1], reverse=True)
-    return [IndexedTraceId(tid, ts) for tid, ts in ranked[:limit]]
+    from zipkin_tpu.store.base import dedup_rank_limit
+
+    return dedup_rank_limit(
+        ((s.trace_id, s.last_timestamp) for s in matched
+         if s.last_timestamp is not None),
+        limit,
+    )
 

@@ -425,14 +425,28 @@ class SqliteSpanStore(SpanStore):
             self._conn.commit()
         return self.get_dependencies()
 
-    def get_dependencies(self) -> Dependencies:
+    def get_dependencies(self, start_ts=None, end_ts=None) -> Dependencies:
+        """Aggregated links, optionally restricted to aggregation rows
+        overlapping [start_ts, end_ts] — each `dependencies` row is one
+        aggregation window, the zipkin_dependencies(start_ts, end_ts)
+        rows of the anormdb schema (DB.scala:88-146)."""
+        cond, args = [], []
+        if end_ts is not None:
+            cond.append("d.start_ts <= ?")
+            args.append(end_ts)
+        if start_ts is not None:
+            cond.append("d.end_ts >= ?")
+            args.append(start_ts)
+        where = (" WHERE " + " AND ".join(cond)) if cond else ""
         with self._lock:
             deps = self._conn.execute(
-                "SELECT MIN(start_ts), MAX(end_ts) FROM dependencies"
+                f"SELECT MIN(d.start_ts), MAX(d.end_ts)"
+                f" FROM dependencies d{where}", args,
             ).fetchone()
             rows = self._conn.execute(
-                "SELECT parent, child, m0, m1, m2, m3, m4"
-                " FROM dependency_links"
+                f"SELECT l.parent, l.child, l.m0, l.m1, l.m2, l.m3, l.m4"
+                f" FROM dependency_links l"
+                f" JOIN dependencies d ON l.dep_id = d.id{where}", args,
             ).fetchall()
         if deps[0] is None:
             return Dependencies.zero()

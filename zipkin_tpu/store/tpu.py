@@ -20,6 +20,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -36,9 +37,14 @@ from zipkin_tpu.columnar.encode import to_signed64
 from zipkin_tpu.concurrency import RWLock
 from zipkin_tpu.store.base import (
     IndexedTraceId,
+    PinBank,
     SpanStore,
     TraceIdDuration,
-    as_bytes,
+    apply_pin_merges,
+    escalate_cap,
+    fill_pin,
+    prune_ttls,
+    resolve_annotation_query,
     should_index,
 )
 
@@ -50,6 +56,77 @@ def _next_pow2(n: int) -> int:
     while p < n:
         p <<= 1
     return p
+
+
+def name_lc_ids(batch: SpanBatch, dicts: DictionarySet,
+                cache: Dict[int, int]) -> np.ndarray:
+    """Lowercased span-name dictionary id per span (-1 for empty names),
+    maintained incrementally through ``cache``."""
+    out = np.empty(batch.n_spans, np.int32)
+    for i, nid in enumerate(batch.name_id):
+        nid = int(nid)
+        lc = cache.get(nid)
+        if lc is None:
+            name = dicts.span_names.decode(nid)
+            lc = -1 if name == "" else dicts.span_names.encode(name.lower())
+            cache[nid] = lc
+        out[i] = lc
+    return out
+
+
+def _pinned_duration(trace_id: int, bank, existing=None):
+    """TraceIdDuration over the pinned spans, widened by any ring
+    result (partial eviction leaves the ring narrower than the bank)."""
+    ts = []
+    for s in bank or ():
+        if s.first_timestamp is not None:
+            ts.append(s.first_timestamp)
+            ts.append(s.last_timestamp)
+    if existing is not None:
+        ts.append(existing.start_timestamp)
+        ts.append(existing.start_timestamp + existing.duration)
+    if not ts:
+        return existing
+    return TraceIdDuration(trace_id, max(ts) - min(ts), min(ts))
+
+
+def decode_gathered(
+    codec: SpanCodec, n_s: int, n_a: int, n_b: int,
+    span_mat: np.ndarray, ann_mat: np.ndarray, bann_mat: np.ndarray,
+) -> List[Span]:
+    """Decode the stacked i64 matrices dev.gather_trace_rows produced
+    (already compacted, spans in insertion order) into Span objects.
+    Shared by the single-store and sharded read paths."""
+    if n_s == 0:
+        return []
+    batch = SpanBatch.empty(n_s, n_a, n_b)
+    for i, col in enumerate(dev.SPAN_MAT_COLS[:-1]):  # row_gid is last
+        tgt = getattr(batch, col)
+        setattr(batch, col, span_mat[i, :n_s].astype(tgt.dtype))
+    gids = span_mat[len(dev.SPAN_MAT_COLS) - 1, :n_s]
+    gid_to_local = {int(g): i for i, g in enumerate(gids)}
+    if n_a:
+        a = {name: ann_mat[i, :n_a]
+             for i, name in enumerate(dev.ANN_MAT_COLS)}
+        batch.ann_span_idx = np.array(
+            [gid_to_local.get(int(g), 0) for g in a["ann_gid"]], np.int32
+        )
+        batch.ann_ts = a["ann_ts"]
+        batch.ann_value_id = a["ann_value_id"].astype(np.int32)
+        batch.ann_service_id = a["ann_service_id"].astype(np.int32)
+        batch.ann_endpoint_id = a["ann_endpoint_id"].astype(np.int32)
+    if n_b:
+        b = {name: bann_mat[i, :n_b]
+             for i, name in enumerate(dev.BANN_MAT_COLS)}
+        batch.bann_span_idx = np.array(
+            [gid_to_local.get(int(g), 0) for g in b["bann_gid"]], np.int32
+        )
+        batch.bann_key_id = b["bann_key_id"].astype(np.int32)
+        batch.bann_value_id = b["bann_value_id"].astype(np.int32)
+        batch.bann_type = b["bann_type"].astype(np.uint8)
+        batch.bann_service_id = b["bann_service_id"].astype(np.int32)
+        batch.bann_endpoint_id = b["bann_endpoint_id"].astype(np.int32)
+    return codec.decode(batch)
 
 
 _SPAN_COLS = ("trace_id", "span_id", "parent_id", "name_id", "service_id",
@@ -82,6 +159,8 @@ class TpuSpanStore(SpanStore):
         # Keyed by to_signed64(trace_id) — ids >= 2^63 arrive unsigned
         # on some write paths and signed on others.
         self.ttls: Dict[int, float] = {}
+        # Eviction-exempt spans of pinned traces (see PinBank).
+        self.pins = PinBank()
         # Annotation rows dropped because a single span carried more than
         # a ring's capacity (the maxTraceCols-style guard).
         self.anns_truncated = 0
@@ -96,17 +175,7 @@ class TpuSpanStore(SpanStore):
     # -- writes ---------------------------------------------------------
 
     def _name_lc_ids(self, batch: SpanBatch) -> np.ndarray:
-        d = self.dicts
-        out = np.empty(batch.n_spans, np.int32)
-        for i, nid in enumerate(batch.name_id):
-            nid = int(nid)
-            lc = self._name_lc.get(nid)
-            if lc is None:
-                name = d.span_names.decode(nid)
-                lc = -1 if name == "" else d.span_names.encode(name.lower())
-                self._name_lc[nid] = lc
-            out[i] = lc
-        return out
+        return name_lc_ids(batch, self.dicts, self._name_lc)
 
     # ItemQueue-aligned chunk bound: keeps jit shapes bounded and batches
     # well under any ring capacity.
@@ -121,6 +190,7 @@ class TpuSpanStore(SpanStore):
         with self._lock:
             for span in spans:
                 self.ttls.setdefault(to_signed64(span.trace_id), 1.0)
+            self.pins.note_write(to_signed64, spans)
             self._prune_ttls()
             # Chunking keeps jit shapes bounded and batches under ring
             # capacity (a single launch must not scatter colliding
@@ -159,17 +229,7 @@ class TpuSpanStore(SpanStore):
             yield batch
 
     def _prune_ttls(self) -> None:
-        """Drop oldest non-pinned TTL entries beyond the bound (ring
-        eviction is the real retention; pins survive)."""
-        excess = len(self.ttls) - self.MAX_TTL_ENTRIES
-        if excess <= 0:
-            return
-        for tid in list(self.ttls):
-            if excess <= 0:
-                break
-            if self.ttls[tid] <= 1.0:
-                del self.ttls[tid]
-                excess -= 1
+        prune_ttls(self.ttls, self.MAX_TTL_ENTRIES)
 
     def write_thrift(self, payload: bytes,
                      sample_threshold: int = 0) -> Tuple[int, int, int]:
@@ -201,6 +261,19 @@ class TpuSpanStore(SpanStore):
                 return 0, dropped, 0
             for tid in np.unique(batch.trace_id):
                 self.ttls.setdefault(int(tid), 1.0)
+            if self.pins:
+                # Fast-path arrivals for pinned traces must reach the
+                # eviction-exempt bank too: decode just those rows.
+                keep = np.isin(
+                    batch.trace_id,
+                    np.fromiter(self.pins.tids(), np.int64,
+                                len(self.pins.tids())),
+                )
+                if keep.any():
+                    pinned_part = self._select_batch(batch, keep)
+                    self.pins.note_write(
+                        to_signed64, self.codec.decode(pinned_part)
+                    )
             self._prune_ttls()
             indexable = native.indexable_from_batch(batch, self.dicts)
             for part, part_lc, part_ix in self._chunk_columnar(
@@ -259,6 +332,30 @@ class TpuSpanStore(SpanStore):
         return dataclasses.replace(
             batch, **{c: getattr(batch, c)[:cap] for c in cols}
         )
+
+    @staticmethod
+    def _select_batch(batch: SpanBatch, keep: np.ndarray) -> SpanBatch:
+        """Columnar selection of arbitrary span rows (bool mask) with
+        their annotation rows, span indices rebased."""
+        idx = np.flatnonzero(keep)
+        remap = np.full(batch.n_spans, -1, np.int32)
+        remap[idx] = np.arange(idx.size, dtype=np.int32)
+        a_sel = keep[batch.ann_span_idx] if batch.n_annotations else (
+            np.zeros(0, bool)
+        )
+        b_sel = keep[batch.bann_span_idx] if batch.n_binary else (
+            np.zeros(0, bool)
+        )
+        out = SpanBatch.empty(idx.size, int(a_sel.sum()), int(b_sel.sum()))
+        for col in _SPAN_COLS:
+            setattr(out, col, getattr(batch, col)[idx])
+        out.ann_span_idx = remap[batch.ann_span_idx[a_sel]]
+        for col in _ANN_COLS:
+            setattr(out, col, getattr(batch, col)[a_sel])
+        out.bann_span_idx = remap[batch.bann_span_idx[b_sel]]
+        for col in _BANN_COLS:
+            setattr(out, col, getattr(batch, col)[b_sel])
+        return out
 
     @staticmethod
     def _slice_batch(batch: SpanBatch, start: int, stop: int) -> SpanBatch:
@@ -330,9 +427,20 @@ class TpuSpanStore(SpanStore):
             self._wp, max(self._wp + incoming - cap, self._wp - cap // 2)
         )
 
+    # TTLs above the per-write default mark a trace pinned: its spans are
+    # materialized to the host pin bank so ring eviction can't drop them.
+    DEFAULT_TTL_S = 1.0
+
     def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
+        tid = to_signed64(trace_id)
         with self._lock:
-            self.ttls[to_signed64(trace_id)] = ttl_seconds
+            self.ttls[tid] = ttl_seconds
+            pin = ttl_seconds > self.DEFAULT_TTL_S
+            if not pin:
+                self.pins.unpin(tid)
+        if pin:
+            fill_pin(self.pins, self._lock, tid, lambda: (
+                self.get_spans_by_trace_ids([trace_id]) or [[]])[0])
 
     def get_time_to_live(self, trace_id: int) -> float:
         with self._lock:
@@ -357,13 +465,12 @@ class TpuSpanStore(SpanStore):
         else:
             name_lc = -1
         with self._rw.read():
-            tids, tss, ok = dev.query_trace_ids_by_service(
+            mat = jax.device_get(dev.query_trace_ids_by_service(
                 self.state, svc, name_lc, end_ts, limit
-            )
-            tids, tss, ok = np.asarray(tids), np.asarray(tss), np.asarray(ok)
+            ))
         return [
             IndexedTraceId(int(t), int(ts))
-            for t, ts, v in zip(tids, tss, ok)
+            for t, ts, v in zip(mat[0], mat[1], mat[2])
             if v
         ]
 
@@ -376,40 +483,18 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service_name)
         if svc is None:
             return []
-        d = self.dicts
-        bann_key = d.binary_keys.get(annotation)
-        bann_key = -1 if bann_key is None else bann_key
-        if value is not None:
-            # Value given: only binary annotations with that exact value
-            # match (memory.py / CassieSpanStore binary index semantics).
-            # The dictionary keys values in their original python form, so
-            # probe both the bytes and the decoded-str representation.
-            ann_value = -1
-            vb = as_bytes(value)
-            bann_value = d.binary_values.get(vb)
-            try:
-                bann_value2 = d.binary_values.get(vb.decode("utf-8"))
-            except UnicodeDecodeError:
-                bann_value2 = None
-            bann_value = -1 if bann_value is None else bann_value
-            bann_value2 = -1 if bann_value2 is None else bann_value2
-            if (bann_value < 0 and bann_value2 < 0) or bann_key < 0:
-                return []
-        else:
-            ann_value = d.annotations.get(annotation)
-            ann_value = -1 if ann_value is None else ann_value
-            bann_value = bann_value2 = -1
-            if ann_value < 0 and bann_key < 0:
-                return []
+        resolved = resolve_annotation_query(self.dicts, annotation, value)
+        if resolved is None:
+            return []
+        ann_value, bann_key, bann_value, bann_value2 = resolved
         with self._rw.read():
-            tids, tss, ok = dev.query_trace_ids_by_annotation(
+            mat = jax.device_get(dev.query_trace_ids_by_annotation(
                 self.state, svc, ann_value, bann_key, bann_value, bann_value2,
                 end_ts, limit,
-            )
-            tids, tss, ok = np.asarray(tids), np.asarray(tss), np.asarray(ok)
+            ))
         return [
             IndexedTraceId(int(t), int(ts))
-            for t, ts, v in zip(tids, tss, ok)
+            for t, ts, v in zip(mat[0], mat[1], mat[2])
             if v
         ]
 
@@ -432,27 +517,52 @@ class TpuSpanStore(SpanStore):
         canon = self._canon_ids(trace_ids)
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
-            st = self.state
-            span_in, _, _ = dev.query_trace_membership(st, qids)
-            present_tids = np.asarray(st.trace_id)[np.asarray(span_in)]
-        return {
-            canon[t] for t in np.unique(present_tids).tolist() if t in canon
+            mat = jax.device_get(dev.query_durations(self.state, qids))
+        out = {
+            canon[int(q)] for q, present in zip(qids, mat[0]) if present
         }
+        with self._lock:
+            if self.pins:
+                out |= {
+                    orig for stid, orig in canon.items()
+                    if stid in self.pins and self.pins.get(stid)
+                }
+        return out
+
+    # Initial static caps for the device-side trace-row gather; escalate
+    # ×8 (bounded by ring capacity) when a read overflows them. Small
+    # caps keep the common case to one ~250KB transfer.
+    GATHER_K0 = 4096
 
     def get_spans_by_trace_ids(self, trace_ids: Sequence[int]) -> List[List[Span]]:
         if not trace_ids:
             return []
         qids = self._sorted_qids(trace_ids)
+        c = self.config
+        k_s = min(self.GATHER_K0, c.capacity)
+        k_a = min(2 * self.GATHER_K0, c.ann_capacity)
+        k_b = min(self.GATHER_K0, c.bann_capacity)
         with self._rw.read():
             st = self.state
-            span_in, ann_in, bann_in = dev.query_trace_membership(st, qids)
-            rows, spans = self._materialize(
-                st,
-                np.asarray(span_in), np.asarray(ann_in), np.asarray(bann_in),
-            )
+            while True:
+                counts, span_mat, ann_mat, bann_mat = jax.device_get(
+                    dev.gather_trace_rows(st, qids, k_s, k_a, k_b)
+                )
+                n_s, n_a, n_b = (int(x) for x in counts)
+                if n_s <= k_s and n_a <= k_a and n_b <= k_b:
+                    break
+                k_s = escalate_cap(n_s, k_s, c.capacity)
+                k_a = escalate_cap(n_a, k_a, c.ann_capacity)
+                k_b = escalate_cap(n_b, k_b, c.bann_capacity)
+        spans = self._decode_gathered(
+            n_s, n_a, n_b, span_mat, ann_mat, bann_mat
+        )
         by_tid: Dict[int, List[Span]] = {}
-        for row, span in zip(rows, spans):
+        for span in spans:
             by_tid.setdefault(span.trace_id, []).append(span)
+        # Pinned traces read through the eviction-exempt bank.
+        with self._lock:
+            apply_pin_merges(self.pins, by_tid, trace_ids, to_signed64)
         # One result per query id, duplicates included — matching the
         # in-memory reference store's behavior.
         return [
@@ -461,68 +571,13 @@ class TpuSpanStore(SpanStore):
             if to_signed64(tid) in by_tid
         ]
 
-    def _materialize(
-        self, st, span_mask: np.ndarray, ann_mask: np.ndarray,
-        bann_mask: np.ndarray,
-    ) -> Tuple[np.ndarray, List[Span]]:
-        """Gather masked ring rows of snapshot ``st`` to host and decode
-        to Span objects, ordered by insertion (global row id). Callers
-        hold the read lock for the lifetime of ``st``."""
-        rows = np.flatnonzero(span_mask)
-        if rows.size == 0:
-            return rows, []
-        gids = np.asarray(st.row_gid)[rows]
-        order = np.argsort(gids, kind="stable")
-        rows = rows[order]
-        gids = gids[order]
-        gid_to_local = {int(g): i for i, g in enumerate(gids)}
-
-        def col(name, idx):
-            return np.asarray(getattr(st, name))[idx]
-
-        n = rows.size
-        batch = SpanBatch.empty(n, 0, 0)
-        for c in ("trace_id", "span_id", "parent_id", "name_id", "service_id",
-                  "ts_cs", "ts_cr", "ts_sr", "ts_ss", "ts_first", "ts_last",
-                  "duration"):
-            setattr(batch, c, col(c, rows))
-        batch.flags = col("flags", rows).astype(np.uint8)
-
-        # Annotations, in ring-age order so per-span insert order survives.
-        arows = np.flatnonzero(ann_mask)
-        if arows.size:
-            a_age = self._ring_age(arows, int(st.ann_write_pos),
-                                   self.config.ann_capacity)
-            arows = arows[np.argsort(a_age, kind="stable")]
-            a_gid = col("ann_gid", arows)
-            batch.ann_span_idx = np.array(
-                [gid_to_local[int(g)] for g in a_gid], np.int32
-            )
-            batch.ann_ts = col("ann_ts", arows)
-            batch.ann_value_id = col("ann_value_id", arows)
-            batch.ann_service_id = col("ann_service_id", arows)
-            batch.ann_endpoint_id = col("ann_endpoint_id", arows)
-        brows = np.flatnonzero(bann_mask)
-        if brows.size:
-            b_age = self._ring_age(brows, int(st.bann_write_pos),
-                                   self.config.bann_capacity)
-            brows = brows[np.argsort(b_age, kind="stable")]
-            b_gid = col("bann_gid", brows)
-            batch.bann_span_idx = np.array(
-                [gid_to_local[int(g)] for g in b_gid], np.int32
-            )
-            batch.bann_key_id = col("bann_key_id", brows)
-            batch.bann_value_id = col("bann_value_id", brows)
-            batch.bann_type = col("bann_type", brows).astype(np.uint8)
-            batch.bann_service_id = col("bann_service_id", brows)
-            batch.bann_endpoint_id = col("bann_endpoint_id", brows)
-        return rows, self.codec.decode(batch)
-
-    @staticmethod
-    def _ring_age(slots: np.ndarray, write_pos: int, capacity: int) -> np.ndarray:
-        """Insertion order of ring slots: oldest → 0. Valid for live rows."""
-        head = write_pos % capacity
-        return (slots - head) % capacity
+    def _decode_gathered(
+        self, n_s: int, n_a: int, n_b: int,
+        span_mat: np.ndarray, ann_mat: np.ndarray, bann_mat: np.ndarray,
+    ) -> List[Span]:
+        return decode_gathered(
+            self.codec, n_s, n_a, n_b, span_mat, ann_mat, bann_mat
+        )
 
     def get_traces_duration(
         self, trace_ids: Sequence[int]
@@ -532,22 +587,28 @@ class TpuSpanStore(SpanStore):
         canon = self._canon_ids(trace_ids)
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
-            found, min_first, max_last = dev.query_durations(self.state, qids)
-            found = np.asarray(found)
-            min_first = np.asarray(min_first)
-            max_last = np.asarray(max_last)
+            mat = jax.device_get(dev.query_durations(self.state, qids))
         by_tid = {
             canon[int(q)]: TraceIdDuration(canon[int(q)], int(mx - mn), int(mn))
-            for q, f, mn, mx in zip(qids, found, min_first, max_last)
+            for q, f, mn, mx in zip(qids, mat[1], mat[2], mat[3])
             if f
         }
+        with self._lock:
+            if self.pins:
+                for stid, orig in canon.items():
+                    if stid not in self.pins:
+                        continue
+                    d = _pinned_duration(orig, self.pins.get(stid),
+                                         by_tid.get(orig))
+                    if d is not None:
+                        by_tid[orig] = d
         return [by_tid[t] for t in trace_ids if t in by_tid]
 
     # -- name catalogs --------------------------------------------------
 
     def get_all_service_names(self) -> Set[str]:
         with self._rw.read():
-            present = np.asarray(self.state.ann_svc_counts) > 0
+            present = jax.device_get(self.state.ann_svc_counts) > 0
         d = self.dicts.services
         return {
             d.decode(i) for i in np.flatnonzero(present)
@@ -559,7 +620,7 @@ class TpuSpanStore(SpanStore):
         if svc is None:
             return set()
         with self._rw.read():
-            row = np.asarray(self.state.name_presence[svc]) > 0
+            row = jax.device_get(self.state.name_presence[svc]) > 0
         d = self.dicts.span_names
         return {
             d.decode(i) for i in np.flatnonzero(row)
@@ -568,21 +629,48 @@ class TpuSpanStore(SpanStore):
 
     # -- analytics (the reference's offline aggregates, served live) ----
 
-    def get_dependencies(self) -> Dependencies:
-        """DependencyLinks from the archive bank + a live-ring join — the
-        live equivalent of Aggregates.getDependencies (Aggregates.scala:31).
+    def get_dependencies(self, start_ts: Optional[int] = None,
+                         end_ts: Optional[int] = None) -> Dependencies:
+        """DependencyLinks from the time-tagged archive banks + a
+        live-ring join — Aggregates.getDependencies(startDate, endDate)
+        (Aggregates.scala:26-31). Without a window, the all-time total;
+        with one, only banks whose children overlap it (bucket-granular).
         Cross-batch parent/child pairs link because the join always runs
         against the resident ring (dev.dep_archive_step docstring)."""
         from zipkin_tpu.aggregate.job import dependencies_from_bank
 
         with self._rw.read():
             st = self.state
-            bank = np.asarray(dev.total_dep_moments(st))
-            ts_min, ts_max = float(st.ts_min), float(st.ts_max)
+            if start_ts is None and end_ts is None:
+                bank, ts_min, ts_max = jax.device_get(
+                    (dev.total_dep_moments(st), st.ts_min, st.ts_max)
+                )
+            else:
+                s = dev.I64_MIN if start_ts is None else int(start_ts)
+                e = dev.I64_MAX if end_ts is None else int(end_ts)
+                bank, ts_min, ts_max = jax.device_get((
+                    dev.dep_moments_in_range(
+                        st, jnp.int64(s), jnp.int64(e)
+                    ),
+                    jnp.maximum(st.ts_min, jnp.int64(s)),
+                    jnp.minimum(st.ts_max, jnp.int64(e)),
+                ))
         return dependencies_from_bank(
             bank, self.dicts.services, self.config.max_services,
-            ts_min, ts_max,
+            float(ts_min), float(ts_max),
         )
+
+    def archive_now(self) -> None:
+        """Fold every unarchived child's links into a fresh time-tagged
+        archive bank immediately (closes the current dependency time
+        bucket — the hourly-aggregation-timer role of
+        zipkin-deployment-web's AnormAggregator schedule)."""
+        with self._lock:
+            with self._rw.write():
+                self.state = dev.dep_archive_step(
+                    self.state, self.state.write_pos
+                )
+            self._archived = self._wp
 
     def service_duration_quantiles(
         self, service: str, qs: Sequence[float]
@@ -592,16 +680,15 @@ class TpuSpanStore(SpanStore):
             return None
         with self._rw.read():
             hist = dev.svc_histogram(self.state)
-            counts = np.asarray(hist.counts[svc])
-        one = Q.LogHistogram(counts, hist.gamma, hist.min_value)
-        return [float(Q.quantile(one, q)) for q in qs]
+            counts = jax.device_get(hist.counts[svc])
+        return Q.quantiles_host(counts, hist.gamma, hist.min_value, qs)
 
     def top_annotations(self, service: str, k: int = 10) -> List[Tuple[str, int]]:
         svc = self._svc_id(service)
         if svc is None:
             return []
         with self._rw.read():
-            row = np.asarray(self.state.ann_value_counts[svc])
+            row = jax.device_get(self.state.ann_value_counts[svc])
         order = np.argsort(-row)[:k]
         d = self.dicts.annotations
         return [
@@ -615,7 +702,7 @@ class TpuSpanStore(SpanStore):
         if svc is None:
             return []
         with self._rw.read():
-            row = np.asarray(self.state.bann_key_counts[svc])
+            row = jax.device_get(self.state.bann_key_counts[svc])
         order = np.argsort(-row)[:k]
         d = self.dicts.binary_keys
         return [
@@ -625,12 +712,13 @@ class TpuSpanStore(SpanStore):
 
     def estimated_unique_traces(self) -> float:
         with self._rw.read():
-            regs = np.asarray(self.state.hll_traces)
+            regs = jax.device_get(self.state.hll_traces)
         return float(hll.estimate(hll.HyperLogLog(regs)))
 
     def counters(self) -> Dict[str, float]:
         with self._rw.read():
-            return {k: float(v) for k, v in self.state.counters.items()}
+            vals = jax.device_get(self.state.counters)
+        return {k: float(v) for k, v in vals.items()}
 
     def stored_span_count(self) -> float:
         """The DEVICE spans_seen counter (one scalar D2H per control

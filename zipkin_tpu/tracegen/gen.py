@@ -108,9 +108,16 @@ class ColumnarTraceGen:
         n_span_names: int = 200,
         spans_per_trace: int = 7,
         seed: int = 0,
+        topology: bool = False,
     ):
+        """``topology=True`` assigns services from a fixed sparse call
+        graph (each service calls two deterministic callees per child
+        slot) instead of uniformly at random — real microservice fleets
+        have O(S) dependency links, not O(S^2); uniform assignment makes
+        every benchmark dep-link bank artificially dense."""
         self.dicts = dicts
         self.spans_per_trace = spans_per_trace
+        self.topology = topology
         self.rng = np.random.default_rng(seed)
         self.service_ids = np.array(
             [dicts.services.encode(f"svc-{i:04d}") for i in range(n_services)],
@@ -153,7 +160,19 @@ class ColumnarTraceGen:
         has_parent = j > 0
         parent_id = np.where(has_parent, trace_id ^ (parent_j + 1), 0)
 
-        svc_idx = rng.integers(0, len(self.service_ids), size=n)
+        S = len(self.service_ids)
+        if self.topology:
+            # Root service random; child j's service is a fixed function
+            # of its parent's service and child slot (heap parent
+            # (j-1)//2, slot 1 or 2) — a sparse static call graph.
+            cols = [rng.integers(0, S, size=n_traces)]
+            for jj in range(1, spt):
+                pj = (jj - 1) // 2
+                slot = jj - 2 * pj
+                cols.append((cols[pj] * 31 + slot) % S)
+            svc_idx = np.stack(cols, axis=1).reshape(-1)
+        else:
+            svc_idx = rng.integers(0, S, size=n)
         service_id = self.service_ids[svc_idx]
         name_id = self.name_ids[rng.integers(0, len(self.name_ids), size=n)]
 
