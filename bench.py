@@ -37,9 +37,18 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:8.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
 
 GOLDEN = 0x9E3779B97F4A7C15
 SPT = 7  # spans per generated trace
@@ -201,10 +210,15 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
     )
 
     # Warm the compile caches on a throwaway state (donated away).
+    _log(f"stream: compiling (capacity 2^{capacity_log2}, "
+         f"{n_services} services, pallas={use_pallas})")
     wstate = dev.init_state(config)
     wstate, wstep = fused_step(wstate, db0, jnp.int64(0))
+    jax.block_until_ready(wstate.counters["spans_seen"])
+    _log("stream: ingest compiled")
     wstate = dev.dep_archive_auto(wstate, pad_spans)
     jax.block_until_ready(wstate.counters["spans_seen"])
+    _log("stream: archive compiled")
     del wstate, wstep
 
     cap = config.capacity
@@ -227,6 +241,9 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
         wp += pad_spans
     jax.block_until_ready(state.counters["spans_seen"])
     dt = time.perf_counter() - t0
+    _log(f"stream: {n_steps * pad_spans} spans in {dt:.1f}s "
+         f"({n_steps * pad_spans / dt / 1e6:.1f}M spans/s, "
+         f"{archive_runs} archive passes)")
 
     # Hand the streamed state to the store so the public query API
     # (device kernels + host decode) serves the read benchmarks.
@@ -249,6 +266,7 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
 def bench_tpu_queries(store, reps: int = 30):
     """Configs #3-#5 + the get_trace_ids read path, through the public
     SpanStore API (wall-clock: device kernel + host materialization)."""
+    _log("queries: starting")
     state = store.state
     end_ts = int(state.ts_max) + 1
     S = store.config.max_services
@@ -330,6 +348,7 @@ def bench_tpu_queries(store, reps: int = 30):
         if isinstance(v, dict) and "p99_ms" in v
     )
     out["worst_query_p99_ms"] = worst
+    _log(f"queries: done (worst p99 {worst:.0f}ms)")
     return out
 
 

@@ -274,6 +274,59 @@ int zk_parse_spans(
   return 0;
 }
 
+// Content-dedup of string slices: assign each (offset, length) slice of
+// ``buf`` a group id such that byte-identical slices share a group, and
+// record one representative slice per group. The python layer then
+// interns each UNIQUE string once and builds dictionary-id columns by
+// vectorized lookup — removing the per-row intern loop from the hot
+// decode (scrooge decodes each struct once; our dictionary encoding
+// makes per-unique work the natural unit).
+//
+// Rows with len < 0 (absent field sentinels) get group -1.
+// Open-addressing FNV-1a table sized to the next power of two >= 2n;
+// returns the number of groups, or -1 if max_groups is exceeded.
+int32_t zk_group_strings(
+    const uint8_t* buf,
+    const int64_t* offs, const int32_t* lens, int32_t n,
+    int32_t* group_of,            // [n] out
+    int64_t* rep_off, int32_t* rep_len,  // [max_groups] out
+    int32_t max_groups) {
+  if (n <= 0) return 0;
+  uint32_t cap = 16;
+  while (cap < (uint32_t)n * 2u) cap <<= 1;
+  // slots hold group index + 1 (0 = empty).
+  int32_t* slots = new int32_t[cap]();
+  int32_t n_groups = 0;
+  for (int32_t i = 0; i < n; i++) {
+    int32_t len = lens[i];
+    if (len < 0) { group_of[i] = -1; continue; }
+    const uint8_t* s = buf + offs[i];
+    uint64_t h = 1469598103934665603ull;  // FNV-1a 64
+    for (int32_t k = 0; k < len; k++) h = (h ^ s[k]) * 1099511628211ull;
+    uint32_t slot = (uint32_t)h & (cap - 1);
+    for (;;) {
+      int32_t g = slots[slot];
+      if (g == 0) {
+        if (n_groups >= max_groups) { delete[] slots; return -1; }
+        rep_off[n_groups] = offs[i];
+        rep_len[n_groups] = len;
+        slots[slot] = n_groups + 1;
+        group_of[i] = n_groups++;
+        break;
+      }
+      int32_t gi = g - 1;
+      if (rep_len[gi] == len &&
+          memcmp(buf + rep_off[gi], s, (size_t)len) == 0) {
+        group_of[i] = gi;
+        break;
+      }
+      slot = (slot + 1) & (cap - 1);
+    }
+  }
+  delete[] slots;
+  return n_groups;
+}
+
 // Standard base64 decode (for scribe LogEntry payloads); returns output
 // length or -1 on bad input. Skips whitespace; handles padding.
 int64_t zk_base64_decode(const uint8_t* in, int64_t in_len, uint8_t* out) {

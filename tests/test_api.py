@@ -85,13 +85,13 @@ class TestRoutes:
             {"serviceName": "api", "timestamp": str(10**18)},
         )
         assert status == 200
-        assert set(body["traceIds"]) == {1, 2}
+        assert set(body["traceIds"]) == {"1", "2"}
         assert len(body["summaries"]) == 2
 
     def test_trace_fetch(self, app):
         status, body = app.handle("GET", "/api/trace/1", {})
         assert status == 200
-        assert body[0]["traceId"] == 1
+        assert body[0]["traceId"] == "1"
         status2, body2 = app.handle("GET", "/api/get/1", {})
         assert status2 == 200 and body2 == body
 
@@ -128,8 +128,8 @@ class TestIngestDoors:
         status, resp = app.handle("POST", "/api/spans", {}, body)
         assert status == 202
         app.collector.flush()
-        status, got = app.handle("GET", "/api/trace/77", {})
-        assert status == 200 and got[0]["traceId"] == 77
+        status, got = app.handle("GET", "/api/trace/4d", {})
+        assert status == 200 and got[0]["traceId"] == "4d"
 
     def test_scribe_ingest(self, app):
         span = rpc(88, 1, None, 50, 60)
@@ -139,7 +139,7 @@ class TestIngestDoors:
         status, resp = app.handle("POST", "/scribe", {}, body)
         assert status == 200 and resp["result"] == "OK"
         app.collector.flush()
-        assert app.handle("GET", "/api/trace/88", {})[0] == 200
+        assert app.handle("GET", "/api/trace/58", {})[0] == 200
 
 
 class TestSocketEndToEnd:
@@ -163,3 +163,62 @@ class TestSocketEndToEnd:
                 assert r.status == 202
         finally:
             server.shutdown()
+
+
+class TestStaticUi:
+    def test_index_served_at_page_routes(self, app):
+        from zipkin_tpu.api.server import RawResponse
+
+        for path in ("/", "/index.html", "/traces", "/aggregate"):
+            status, payload = app.handle("GET", path, {})
+            assert status == 200
+            assert isinstance(payload, RawResponse)
+            assert payload.content_type.startswith("text/html")
+            body = payload.body.decode("utf-8")
+            # The SPA drives the real API routes.
+            for needle in ("/api/query", "/api/trace/", "/api/dependencies",
+                           "renderTrace", "renderDeps"):
+                assert needle in body
+
+
+class TestSelfTracing:
+    def _app(self):
+        from zipkin_tpu.ingest.collector import Collector
+        from zipkin_tpu.store.memory import InMemorySpanStore
+
+        store = InMemorySpanStore()
+        collector = Collector(store, concurrency=1)
+        api = ApiServer(QueryService(store), collector)
+        return store, collector, api
+
+    def test_query_requests_produce_self_traces(self):
+        store, collector, api = self._app()
+        status, _ = api.handle("GET", "/api/services", {})
+        assert status == 200
+        collector.flush()
+        assert "zipkin-query" in store.get_all_service_names()
+        names = store.get_span_names("zipkin-query")
+        assert "get /api/services" in names
+        # The self-trace is queryable through the API itself.
+        status, body = api.handle(
+            "GET", "/api/query", {"serviceName": "zipkin-query"})
+        collector.flush()
+        assert status == 200 and body["traceIds"]
+
+    def test_b3_continuation(self):
+        store, collector, api = self._app()
+        api.handle("GET", "/api/services", {},
+                   headers={"X-B3-TraceId": "abcd1234",
+                            "X-B3-SpanId": "1111",
+                            "X-B3-ParentSpanId": "2222"})
+        collector.flush()
+        spans = store.get_spans_by_trace_id(0xABCD1234)
+        assert spans and spans[0].id == 0x1111
+        assert spans[0].parent_id == 0x2222
+
+    def test_ingest_doors_not_traced(self):
+        store, collector, api = self._app()
+        api.handle("POST", "/api/spans", {}, b"[]")
+        api.handle("GET", "/health", {})
+        collector.flush()
+        assert "zipkin-query" not in store.get_all_service_names()

@@ -134,3 +134,63 @@ class TestMains:
         assert status == 200 and body["sampleRate"] == 0.25
         assert collector.sampler.rate == 0.25
         collector.close()
+
+
+def test_pinned_traces_survive_checkpoint_restart(tmp_path):
+    """Pin → save → load → flood: the eviction-exempt bank restores
+    with the TTL, so the retention contract holds across restarts."""
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+    from zipkin_tpu.store.device import StoreConfig
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu import checkpoint
+
+    cfg = StoreConfig(
+        capacity=256, ann_capacity=1024, bann_capacity=512,
+        max_services=16, max_span_names=32, max_annotation_values=64,
+        max_binary_keys=16, cms_width=256, hll_p=6, quantile_buckets=128,
+    )
+    store = TpuSpanStore(cfg)
+    ep = Endpoint(1, 80, "pinned-svc")
+    tid = 777
+    store.apply([Span(tid, "op", 1, None,
+                      (Annotation(10, "sr", ep), Annotation(20, "ss", ep)),
+                      ())])
+    store.set_time_to_live(tid, 30 * 24 * 3600.0)
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(store, path)
+
+    restored = checkpoint.load(path)
+    assert restored.get_time_to_live(tid) == 30 * 24 * 3600.0
+    noise_ep = Endpoint(2, 80, "noise")
+    for i in range(0, 2 * cfg.capacity, 128):
+        restored.apply([
+            Span(10_000 + i + j, "n", 50_000 + i + j, None,
+                 (Annotation(30 + j, "sr", noise_ep),), ())
+            for j in range(128)
+        ])
+    got = restored.get_spans_by_trace_id(tid)
+    assert len(got) == 1 and got[0].id == 1
+    assert tid in restored.traces_exist([tid])
+
+
+def test_pin_bank_dedups_redelivered_spans():
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+    from zipkin_tpu.store.device import StoreConfig
+    from zipkin_tpu.store.tpu import TpuSpanStore
+
+    cfg = StoreConfig(
+        capacity=256, ann_capacity=1024, bann_capacity=512,
+        max_services=16, max_span_names=32, max_annotation_values=64,
+        max_binary_keys=16, cms_width=256, hll_p=6, quantile_buckets=128,
+    )
+    store = TpuSpanStore(cfg)
+    ep = Endpoint(1, 80, "svc")
+    tid = 888
+    span = Span(tid, "op", 1, None, (Annotation(10, "sr", ep),), ())
+    store.apply([span])
+    store.set_time_to_live(tid, 30 * 24 * 3600.0)
+    # Transport retry re-delivers the identical span 5 times.
+    for _ in range(5):
+        store.apply([span])
+    bank = store.pins.get(store.pins.tids().pop())
+    assert len(bank) == 1

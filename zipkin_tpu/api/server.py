@@ -31,6 +31,14 @@ DEFAULT_PIN_TTL_S = 30 * 24 * 3600  # webPinTtl default 30 days
 DEFAULT_TTL_S = 1.0
 
 
+class RawResponse:
+    """Non-JSON payload (the static UI) with its content type."""
+
+    def __init__(self, content_type: str, body: bytes):
+        self.content_type = content_type
+        self.body = body
+
+
 def _trace_json(trace):
     return [span_to_json(s) for s in trace.spans]
 
@@ -47,10 +55,22 @@ class ApiServer:
     can drive it without sockets."""
 
     def __init__(self, query: QueryService, collector: Optional[Collector] = None,
-                 pin_ttl_s: float = DEFAULT_PIN_TTL_S):
+                 pin_ttl_s: float = DEFAULT_PIN_TTL_S,
+                 self_trace: bool = True,
+                 self_service_name: str = "zipkin-query"):
         self.query = query
         self.collector = collector
         self.pin_ttl_s = pin_ttl_s
+        # Self-tracing (SURVEY §5): the query service records a server
+        # span per API request into its own collector, continuing any
+        # incoming B3 trace — the finagle-zipkin role the reference
+        # wires everywhere (ThriftQueryService.scala:139-144,
+        # QueryService.scala:216-222).
+        self.tracer = None
+        if collector is not None and self_trace:
+            from zipkin_tpu.client import Tracer
+
+            self.tracer = Tracer(self_service_name, self._self_transport)
         # Scribe rides the columnar fast path (raw thrift bytes →
         # native parse on a collector worker); the collector falls back
         # to the python codec when the native library is unavailable.
@@ -73,8 +93,45 @@ class ApiServer:
 
     # -- dispatch -------------------------------------------------------
 
+    def _self_transport(self, spans) -> None:
+        try:
+            self.collector.accept(spans)
+        except Exception:
+            pass  # self-tracing must never fail a request
+
+    def _should_self_trace(self, method: str, path: str) -> bool:
+        if self.tracer is None or not path.startswith("/api/"):
+            return False
+        # Don't trace the ingest doors — a span per accepted span batch
+        # would feed back into the stream it measures.
+        return not (method == "POST" and path in ("/api/spans",
+                                                  "/api/v1/spans"))
+
     def handle(self, method: str, path: str, params: dict,
-               body: bytes = b"") -> Tuple[int, object]:
+               body: bytes = b"", headers: Optional[dict] = None
+               ) -> Tuple[int, object]:
+        if not self._should_self_trace(method, path):
+            return self._dispatch(method, path, params, body)
+        import time as _time
+
+        from zipkin_tpu.client import B3Headers
+
+        b3 = B3Headers.parse(headers or {})
+        start_us = int(_time.time() * 1e6)
+        status = 500
+        try:
+            status, payload = self._dispatch(method, path, params, body)
+            return status, payload
+        finally:
+            self.tracer.server_span(
+                f"{method.lower()} {path}", b3,
+                start_us=start_us, end_us=int(_time.time() * 1e6),
+                tags={"http.uri": path, "http.method": method,
+                      "http.status": str(status)},
+            )
+
+    def _dispatch(self, method: str, path: str, params: dict,
+                  body: bytes) -> Tuple[int, object]:
         try:
             return self._route(method, path, params, body)
         except QueryException as e:
@@ -85,6 +142,14 @@ class ApiServer:
             return 400, {"error": str(e)}
 
     def _route(self, method, path, params, body):
+        if path in ("/", "/index.html", "/traces", "/aggregate"):
+            # The SPA serves every page route (web/Main.scala:77-89's
+            # /, /traces/:id, /aggregate mustache pages collapse into
+            # one client-rendered file).
+            from zipkin_tpu import web
+
+            return 200, RawResponse("text/html; charset=utf-8",
+                                    web.index_html())
         if path == "/health":
             return 200, {"status": "ok"}
         if path == "/metrics":
@@ -104,15 +169,19 @@ class ApiServer:
                 _require(params, "serviceName"))
         if path == "/api/dependencies" or re.match(r"^/api/dependencies/", path):
             return self._dependencies(path, params)
-        m = re.match(r"^/api/(?:trace|get)/(-?\d+)$", path)
+        # Trace ids in paths are unsigned hex (upstream zipkin URL
+        # convention; span_to_json emits the same form). A leading "-"
+        # keeps accepting legacy signed-decimal callers unambiguously.
+        m = re.match(r"^/api/(?:trace|get)/(-?[0-9a-fA-F]+)$", path)
         if m:
-            return self._trace(int(m.group(1)), params)
-        m = re.match(r"^/api/is_pinned/(-?\d+)$", path)
+            return self._trace(_parse_trace_id(m.group(1)), params)
+        m = re.match(r"^/api/is_pinned/(-?[0-9a-fA-F]+)$", path)
         if m:
-            return self._is_pinned(int(m.group(1)))
-        m = re.match(r"^/api/pin/(-?\d+)/(true|false)$", path)
+            return self._is_pinned(_parse_trace_id(m.group(1)))
+        m = re.match(r"^/api/pin/(-?[0-9a-fA-F]+)/(true|false)$", path)
         if m and method == "POST":
-            return self._pin(int(m.group(1)), m.group(2) == "true")
+            return self._pin(_parse_trace_id(m.group(1)),
+                             m.group(2) == "true")
         if method == "POST" and path in ("/api/spans", "/api/v1/spans"):
             return self._ingest_json(body)
         if method == "POST" and path == "/scribe":
@@ -137,15 +206,17 @@ class ApiServer:
         qr = extract_query(params)
         if qr is None:
             return 400, {"error": "serviceName is required"}
+        from zipkin_tpu.ingest.receiver import _hex_id
+
         resp = self.query.get_trace_ids(qr)
         summaries = self.query.get_trace_summaries_by_ids(resp.trace_ids)
         return 200, {
-            "traceIds": list(resp.trace_ids),
+            "traceIds": [_hex_id(t) for t in resp.trace_ids],
             "startTs": resp.start_ts,
             "endTs": resp.end_ts,
             "summaries": [
                 {
-                    "traceId": s.trace_id,
+                    "traceId": _hex_id(s.trace_id),
                     "startTimestamp": s.start_timestamp,
                     "endTimestamp": s.end_timestamp,
                     "durationMicro": s.duration_micro,
@@ -245,6 +316,13 @@ class ApiServer:
         return out
 
 
+def _parse_trace_id(raw: str) -> int:
+    """Unsigned hex (the wire form) or signed decimal (legacy)."""
+    if raw.startswith("-"):
+        return int(raw)
+    return int(raw, 16)
+
+
 def _require(params, key):
     v = params.get(key)
     if not v:
@@ -261,11 +339,16 @@ def make_server(api: ApiServer, host: str = "0.0.0.0", port: int = 9411
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             status, payload = api.handle(
-                self.command, parsed.path, params, body
+                self.command, parsed.path, params, body,
+                headers=dict(self.headers),
             )
-            data = json.dumps(payload).encode("utf-8")
+            if isinstance(payload, RawResponse):
+                ctype, data = payload.content_type, payload.body
+            else:
+                ctype = "application/json"
+                data = json.dumps(payload).encode("utf-8")
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)

@@ -28,6 +28,7 @@ from zipkin_tpu.store.tpu import TpuSpanStore
 
 _STATE_FILE = "state.npz"
 _META_FILE = "meta.json"
+_PINS_FILE = "pins.pkl"
 # Bump when the StoreState schema changes in a way load() must adapt to.
 _REVISION = 3
 
@@ -72,10 +73,21 @@ def save(store: TpuSpanStore, path: str) -> None:
                     leaves[f"counters.{k}"] = np.asarray(v)
             else:
                 leaves[name] = np.asarray(value)
+    with store._lock:
+        # Pinned traces' eviction-exempt banks must survive restarts —
+        # the TTL alone restoring while the spans vanish would break the
+        # retention contract pinning exists for (SpanStore.scala:66).
+        # Pickled (not wire-encoded): both the JSON and thrift codecs
+        # normalize bytes-vs-str values, and the bank must restore the
+        # exact objects reads were returning before the restart.
+        pins_snapshot = {
+            tid: list(bank) for tid, bank in store.pins.items()
+        }
+        ttls_snapshot = {str(k): v for k, v in store.ttls.items()}
     meta = {
         "revision": _REVISION,
         "config": store.config._asdict(),
-        "ttls": {str(k): v for k, v in store.ttls.items()},
+        "ttls": ttls_snapshot,
         "name_lc": {str(k): v for k, v in store._name_lc.items()},
         "dicts": {
             "services": _dict_dump(store.dicts.services),
@@ -93,6 +105,11 @@ def save(store: TpuSpanStore, path: str) -> None:
         np.savez_compressed(os.path.join(tmp, _STATE_FILE), **leaves)
         with open(os.path.join(tmp, _META_FILE), "w") as f:
             json.dump(meta, f)
+        if pins_snapshot:
+            import pickle
+
+            with open(os.path.join(tmp, _PINS_FILE), "wb") as f:
+                pickle.dump(pins_snapshot, f)
         # Keep the previous checkpoint alive until the new one is in
         # place: path → path.old, tmp → path, then drop path.old. A crash
         # at any point leaves either path or path.old restorable (load()
@@ -141,6 +158,13 @@ def load(path: str) -> TpuSpanStore:
     store = TpuSpanStore(config, codec=SpanCodec(dicts))
     store.ttls = {int(k): v for k, v in meta["ttls"].items()}
     store._name_lc = {int(k): v for k, v in meta["name_lc"].items()}
+    pins_path = os.path.join(path, _PINS_FILE)
+    if os.path.exists(pins_path):
+        import pickle
+
+        with open(pins_path, "rb") as f:
+            for tid, bank in pickle.load(f).items():
+                store.pins.pin(int(tid), bank)
 
     data = np.load(os.path.join(path, _STATE_FILE))
     upd = {}

@@ -214,7 +214,11 @@ class QueryClient:
         )
         return self._get(f"/api/query?{qs}")
 
-    def trace(self, trace_id: int) -> List[dict]:
+    def trace(self, trace_id) -> List[dict]:
+        """``trace_id`` as int (formatted as unsigned hex, the URL
+        convention) or an already-hex string from a query response."""
+        if isinstance(trace_id, int):
+            trace_id = f"{trace_id & (2**64 - 1):x}"
         return self._get(f"/api/trace/{trace_id}")
 
     def dependencies(self) -> dict:

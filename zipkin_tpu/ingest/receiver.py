@@ -173,20 +173,29 @@ def span_from_json(d: dict) -> Span:
                 host=_endpoint_from_json(b.get("endpoint")),
             )
         )
+    def _id(v):
+        """Hex string (the wire form) or number → canonical SIGNED
+        int64 — keeps span_to_json → span_from_json an exact round
+        trip for ids with the top bit set."""
+        u = int(v, 16) if isinstance(v, str) else int(v)
+        return u - (1 << 64) if u >= (1 << 63) else u
+
     return Span(
-        trace_id=int(d["traceId"], 16) if isinstance(d["traceId"], str)
-        else int(d["traceId"]),
+        trace_id=_id(d["traceId"]),
         name=d.get("name", ""),
-        id=int(d["id"], 16) if isinstance(d["id"], str) else int(d["id"]),
+        id=_id(d["id"]),
         parent_id=(
             None if d.get("parentId") in (None, "")
-            else int(d["parentId"], 16) if isinstance(d["parentId"], str)
-            else int(d["parentId"])
+            else _id(d["parentId"])
         ),
         annotations=anns,
         binary_annotations=tuple(banns),
         debug=bool(d.get("debug", False)),
     )
+
+
+def _hex_id(v: int) -> str:
+    return f"{v & (2**64 - 1):x}"
 
 
 def span_to_json(s: Span) -> dict:
@@ -209,11 +218,15 @@ def span_to_json(s: Span) -> dict:
             "key": b.key, "value": value,
             "type": b.annotation_type.name, "endpoint": ep(b.host),
         })
+    # Ids serialize as unsigned hex STRINGS (upstream zipkin JSON
+    # convention, and span_from_json's string interpretation): a JSON
+    # number round-trips through JS float64, which silently rounds ids
+    # above 2^53 — the UI would then fetch the wrong trace.
     return {
-        "traceId": s.trace_id,
+        "traceId": _hex_id(s.trace_id),
         "name": s.name,
-        "id": s.id,
-        "parentId": s.parent_id,
+        "id": _hex_id(s.id),
+        "parentId": None if s.parent_id is None else _hex_id(s.parent_id),
         "annotations": [
             {"timestamp": a.timestamp, "value": a.value,
              "endpoint": ep(a.host)}

@@ -38,6 +38,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint dir: restore at boot, save on exit "
                         "and every --checkpoint-interval seconds")
     p.add_argument("--checkpoint-interval", type=float, default=300.0)
+    p.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                   help="force the jax backend (a sitecustomize-"
+                        "registered accelerator plugin wins over "
+                        "JAX_PLATFORMS, so an env var is not enough)")
     return p
 
 
@@ -88,6 +92,10 @@ def seed(collector, n_traces: int) -> None:
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     store, collector, api = build_app(args)
     if args.seed_traces:
         seed(collector, args.seed_traces)

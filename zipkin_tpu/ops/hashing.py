@@ -64,6 +64,26 @@ def hash2_32(hi, lo, seed):
     return h
 
 
+def mix_keys64(keys):
+    """Device: fold N int64 key columns into one well-dispersed uint64
+    (splitmix64-style finalizer). Used to turn multi-key sorts into
+    single-key sorts: XLA's TPU sort compile time grows drastically with
+    operand count at multi-million row shapes, while equal composite
+    keys still collide to equal hashes (callers re-verify equality on
+    the original keys after the sort)."""
+    acc = jnp.uint64(0x243F6A8885A308D3)  # pi
+    for k in keys:
+        acc = (acc ^ jnp.asarray(k).astype(jnp.uint64)) * jnp.uint64(
+            0x9E3779B97F4A7C15
+        )
+        acc ^= acc >> 29
+    acc *= jnp.uint64(0xBF58476D1CE4E5B9)
+    acc ^= acc >> 32
+    acc *= jnp.uint64(0x94D049BB133111EB)
+    acc ^= acc >> 29
+    return acc
+
+
 def clz32(x):
     """Count leading zeros of uint32 (vectorized, integer-only)."""
     x = jnp.asarray(x, jnp.uint32)

@@ -181,8 +181,6 @@ class ShardedSpanStore:
     in-memory and single-device stores (SpanStoreValidator.scala:27).
     """
 
-    GATHER_K0 = 4096
-
     def __init__(self, mesh: Mesh, config: dev.StoreConfig,
                  axis: str = "shard", codec=None):
         import threading
@@ -428,18 +426,23 @@ class ShardedSpanStore:
     def _svc_id(self, service_name: str):
         return self.dicts.services.get(service_name.lower())
 
-    def _merge_topk(self, mats: np.ndarray, limit: int):
-        from zipkin_tpu.store.base import dedup_rank_limit
-
-        return dedup_rank_limit(
-            ((int(t), int(ts))
-             for sh in range(mats.shape[0])
-             for t, ts, v in zip(*mats[sh])
-             if v),
-            limit,
-        )
+    @staticmethod
+    def _shard_candidates(mats: np.ndarray, k: int):
+        """Flatten per-shard candidate matrices [n, 3, k]; truncated if
+        ANY shard filled its window."""
+        cands, truncated = [], False
+        for sh in range(mats.shape[0]):
+            n_valid = 0
+            for t, ts, v in zip(*mats[sh]):
+                if v:
+                    cands.append((int(t), int(ts)))
+                    n_valid += 1
+            truncated |= n_valid >= k
+        return cands, truncated
 
     def get_trace_ids_by_name(self, service_name, span_name, end_ts, limit):
+        from zipkin_tpu.store.base import topk_ids_with_escalation
+
         svc = self._svc_id(service_name)
         if svc is None or limit <= 0:
             return []
@@ -449,12 +452,18 @@ class ShardedSpanStore:
                 return []
         else:
             name_lc = -1
-        with self._rw.read():
-            mats = jax.device_get(self._q_by_service(limit)(
-                self.states, jnp.int32(svc), jnp.int32(name_lc),
-                jnp.int64(end_ts),
-            ))
-        return self._merge_topk(mats, limit)
+
+        def fetch(k):
+            with self._rw.read():
+                mats = jax.device_get(self._q_by_service(k)(
+                    self.states, jnp.int32(svc), jnp.int32(name_lc),
+                    jnp.int64(end_ts),
+                ))
+            return self._shard_candidates(mats, k)
+
+        return topk_ids_with_escalation(
+            limit, self.config.ann_capacity, fetch
+        )
 
     def get_trace_ids_by_annotation(self, service_name, annotation, value,
                                     end_ts, limit):
@@ -466,17 +475,26 @@ class ShardedSpanStore:
         svc = self._svc_id(service_name)
         if svc is None:
             return []
+        from zipkin_tpu.store.base import topk_ids_with_escalation
+
         resolved = resolve_annotation_query(self.dicts, annotation, value)
         if resolved is None:
             return []
         ann_value, bann_key, bann_value, bann_value2 = resolved
-        with self._rw.read():
-            mats = jax.device_get(self._q_by_annotation(limit)(
-                self.states, jnp.int32(svc), jnp.int32(ann_value),
-                jnp.int32(bann_key), jnp.int32(bann_value),
-                jnp.int32(bann_value2), jnp.int64(end_ts),
-            ))
-        return self._merge_topk(mats, limit)
+
+        def fetch(k):
+            with self._rw.read():
+                mats = jax.device_get(self._q_by_annotation(k)(
+                    self.states, jnp.int32(svc), jnp.int32(ann_value),
+                    jnp.int32(bann_key), jnp.int32(bann_value),
+                    jnp.int32(bann_value2), jnp.int64(end_ts),
+                ))
+            return self._shard_candidates(mats, k)
+
+        c = self.config
+        return topk_ids_with_escalation(
+            limit, c.ann_capacity + c.bann_capacity, fetch
+        )
 
     # -- trace reads -----------------------------------------------------
 
@@ -494,21 +512,16 @@ class ShardedSpanStore:
             return set()
         canon = {to_signed64(t): t for t in trace_ids}
         qids = self._sorted_qids(trace_ids)
+        from zipkin_tpu.store.base import exist_from_duration_mat
+
         with self._rw.read():
             mat = jax.device_get(self._q_durations()(self.states, qids))
-        out = {canon[int(q)] for q, p in zip(qids, mat[0]) if p}
-        with self._lock:
-            if self.pins:
-                out |= {
-                    orig for stid, orig in canon.items()
-                    if stid in self.pins and self.pins.get(stid)
-                }
-        return out
+        return exist_from_duration_mat(canon, qids, mat[0], self.pins,
+                                       self._lock)
 
     def get_traces_duration(self, trace_ids):
         from zipkin_tpu.columnar.encode import to_signed64
-        from zipkin_tpu.store.base import TraceIdDuration
-        from zipkin_tpu.store.tpu import _pinned_duration
+        from zipkin_tpu.store.base import durations_from_mat
 
         if not trace_ids:
             return []
@@ -516,21 +529,8 @@ class ShardedSpanStore:
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
             mat = jax.device_get(self._q_durations()(self.states, qids))
-        by_tid = {
-            canon[int(q)]: TraceIdDuration(canon[int(q)], int(mx - mn), int(mn))
-            for q, f, mn, mx in zip(qids, mat[1], mat[2], mat[3])
-            if f
-        }
-        with self._lock:
-            if self.pins:
-                for stid, orig in canon.items():
-                    if stid not in self.pins:
-                        continue
-                    d = _pinned_duration(orig, self.pins.get(stid),
-                                         by_tid.get(orig))
-                    if d is not None:
-                        by_tid[orig] = d
-        return [by_tid[t] for t in trace_ids if t in by_tid]
+        return durations_from_mat(trace_ids, canon, qids, mat, self.pins,
+                                  self._lock)
 
     def get_spans_by_trace_ids(self, trace_ids):
         from zipkin_tpu.columnar.encode import to_signed64
@@ -538,26 +538,24 @@ class ShardedSpanStore:
 
         if not trace_ids:
             return []
-        from zipkin_tpu.store.base import apply_pin_merges, escalate_cap
+        from zipkin_tpu.store.base import (
+            apply_pin_merges,
+            gather_with_escalation,
+        )
 
         qids = self._sorted_qids(trace_ids)
-        c = self.config
-        k_s = min(self.GATHER_K0, c.capacity)
-        k_a = min(2 * self.GATHER_K0, c.ann_capacity)
-        k_b = min(self.GATHER_K0, c.bann_capacity)
         with self._rw.read():
-            while True:
+
+            def fetch(k_s, k_a, k_b):
                 counts, s_m, a_m, b_m = jax.device_get(
                     self._q_gather(k_s, k_a, k_b)(self.states, qids)
                 )
-                n_s = int(counts[:, 0].max())
-                n_a = int(counts[:, 1].max())
-                n_b = int(counts[:, 2].max())
-                if n_s <= k_s and n_a <= k_a and n_b <= k_b:
-                    break
-                k_s = escalate_cap(n_s, k_s, c.capacity)
-                k_a = escalate_cap(n_a, k_a, c.ann_capacity)
-                k_b = escalate_cap(n_b, k_b, c.bann_capacity)
+                return (int(counts[:, 0].max()), int(counts[:, 1].max()),
+                        int(counts[:, 2].max()), (counts, s_m, a_m, b_m))
+
+            counts, s_m, a_m, b_m = gather_with_escalation(
+                self.config, fetch
+            )
         spans = []
         for sh in range(self.n):
             spans.extend(decode_gathered(
@@ -627,11 +625,18 @@ class ShardedSpanStore:
                 st = self._unstack(state)
                 bank = dev.dep_moments_in_range(st, start_ts, end_ts)
                 banks = jax.lax.all_gather(bank, self.axis)
-                return M.reduce_moments(banks, axis=0)
+                # ts range rides the same launch — running the full
+                # summary kernel just to clip two scalars would
+                # all-reduce every catalog array per windowed query.
+                ts_min = jnp.maximum(jax.lax.pmin(st.ts_min, self.axis),
+                                     start_ts)
+                ts_max = jnp.minimum(jax.lax.pmax(st.ts_max, self.axis),
+                                     end_ts)
+                return M.reduce_moments(banks, axis=0), ts_min, ts_max
 
             return jax.jit(jax.shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis), P(), P()),
-                out_specs=P(), check_vma=False,
+                out_specs=(P(), P(), P()), check_vma=False,
             ))
 
         return self._kernel(("deps_range",), build)
@@ -640,8 +645,8 @@ class ShardedSpanStore:
         from zipkin_tpu.aggregate.job import dependencies_from_bank
 
         with self._rw.read():
-            summary = self._summary_kernel()(self.states)
             if start_ts is None and end_ts is None:
+                summary = self._summary_kernel()(self.states)
                 bank, ts_min, ts_max = jax.device_get(
                     (summary["dep_moments"], summary["ts_min"],
                      summary["ts_max"])
@@ -649,13 +654,11 @@ class ShardedSpanStore:
             else:
                 s = dev.I64_MIN if start_ts is None else int(start_ts)
                 e = dev.I64_MAX if end_ts is None else int(end_ts)
-                bank = jax.device_get(self._deps_range_kernel()(
-                    self.states, jnp.int64(s), jnp.int64(e)
-                ))
-                ts_min, ts_max = jax.device_get(
-                    (summary["ts_min"], summary["ts_max"])
+                bank, ts_min, ts_max = jax.device_get(
+                    self._deps_range_kernel()(
+                        self.states, jnp.int64(s), jnp.int64(e)
+                    )
                 )
-                ts_min, ts_max = max(int(ts_min), s), min(int(ts_max), e)
         return dependencies_from_bank(
             bank, self.dicts.services, self.config.max_services,
             float(ts_min), float(ts_max),

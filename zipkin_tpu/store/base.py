@@ -73,10 +73,17 @@ class PinBank:
     fresh as later spans of the trace arrive, and trace-id read paths
     union it with ring results — the pinned trace stays fully readable
     after the ring has lapped it. Unpinning drops the entry.
+
+    Banks dedup on append (transport retries re-deliver spans) and are
+    bounded per trace by MAX_SPANS_PER_TRACE — the maxTraceCols guard
+    (CassieSpanStore.scala:50) applied to pinned retention.
     """
+
+    MAX_SPANS_PER_TRACE = 100_000
 
     def __init__(self):
         self._pins = {}
+        self._seen = {}  # tid -> set of banked spans (dedup)
 
     def __bool__(self) -> bool:
         return bool(self._pins)
@@ -85,10 +92,17 @@ class PinBank:
         return tid in self._pins
 
     def pin(self, tid: int, spans) -> None:
-        self._pins[tid] = list(spans)
+        out, seen = [], set()
+        for s in spans:
+            if s not in seen and len(out) < self.MAX_SPANS_PER_TRACE:
+                out.append(s)
+                seen.add(s)
+        self._pins[tid] = out
+        self._seen[tid] = seen
 
     def unpin(self, tid: int) -> None:
         self._pins.pop(tid, None)
+        self._seen.pop(tid, None)
 
     def get(self, tid: int):
         return self._pins.get(tid)
@@ -96,15 +110,23 @@ class PinBank:
     def tids(self):
         return set(self._pins)
 
+    def items(self):
+        return self._pins.items()
+
     def note_write(self, key_of, spans) -> None:
         """Append incoming spans of already-pinned traces — post-pin
-        arrivals must survive eviction too."""
+        arrivals must survive eviction too. Idempotent per span."""
         if not self._pins:
             return
         for s in spans:
-            bank = self._pins.get(key_of(s.trace_id))
-            if bank is not None:
+            tid = key_of(s.trace_id)
+            bank = self._pins.get(tid)
+            if bank is None:
+                continue
+            seen = self._seen[tid]
+            if s not in seen and len(bank) < self.MAX_SPANS_PER_TRACE:
                 bank.append(s)
+                seen.add(s)
 
     def merge(self, tid: int, ring_spans):
         """Union bank + ring rows for one trace: bank spans (inserted
@@ -186,6 +208,24 @@ def resolve_annotation_query(dicts, annotation: str, value):
     return ann_value, bann_key, bann_value, bann_value2
 
 
+def topk_ids_with_escalation(limit: int, k_max: int, fetch,
+                             k0: int = 64) -> List["IndexedTraceId"]:
+    """Escalating candidate fetch for index queries: ``fetch(k)``
+    returns (candidates [(tid, ts)...], truncated) off the device top-k
+    kernel; when dedup-by-trace can't fill ``limit`` AND the candidate
+    window was full (a hot trace may have crowded it), re-query with
+    k×8. Exact: any trace absent from a candidate window ranks below
+    every candidate in it, so ``limit`` distinct found traces are the
+    true top ``limit``."""
+    k = min(max(k0, 4 * limit), max(k_max, 1))
+    while True:
+        candidates, truncated = fetch(k)
+        ids = dedup_rank_limit(candidates, limit)
+        if len(ids) >= limit or not truncated or k >= k_max:
+            return ids
+        k = min(k * 8, k_max)
+
+
 def dedup_rank_limit(candidates, limit: int) -> List["IndexedTraceId"]:
     """One IndexedTraceId per trace id (max timestamp wins), sorted by
     timestamp descending, truncated to ``limit`` — the dedup-before-limit
@@ -218,6 +258,76 @@ def escalate_cap(n: int, k: int, cap: int) -> int:
     while n > k:
         k = min(k * 8, cap)
     return k
+
+
+GATHER_K0 = 4096
+
+
+def gather_with_escalation(config, fetch, k0: int = GATHER_K0):
+    """Run a device trace-row gather with cap escalation: ``fetch(k_s,
+    k_a, k_b)`` returns (n_s, n_a, n_b, payload); retried with ×8 caps
+    until the counts fit (bounded by the ring capacities). Shared retry
+    policy of the single-store and sharded whole-trace reads."""
+    k_s = min(k0, config.capacity)
+    k_a = min(2 * k0, config.ann_capacity)
+    k_b = min(k0, config.bann_capacity)
+    while True:
+        n_s, n_a, n_b, payload = fetch(k_s, k_a, k_b)
+        if n_s <= k_s and n_a <= k_a and n_b <= k_b:
+            return payload
+        k_s = escalate_cap(n_s, k_s, config.capacity)
+        k_a = escalate_cap(n_a, k_a, config.ann_capacity)
+        k_b = escalate_cap(n_b, k_b, config.bann_capacity)
+
+
+def pinned_duration(trace_id: int, bank, existing=None):
+    """TraceIdDuration over a pinned trace's banked spans, widened by
+    any ring result (partial eviction leaves the ring narrower)."""
+    ts = []
+    for s in bank or ():
+        if s.first_timestamp is not None:
+            ts.append(s.first_timestamp)
+            ts.append(s.last_timestamp)
+    if existing is not None:
+        ts.append(existing.start_timestamp)
+        ts.append(existing.start_timestamp + existing.duration)
+    if not ts:
+        return existing
+    return TraceIdDuration(trace_id, max(ts) - min(ts), min(ts))
+
+
+def exist_from_duration_mat(canon, qids, present_row, pins: PinBank, lock):
+    """traces_exist result from the stacked durations kernel's present
+    row, unioned with requested pinned traces (shared by both stores)."""
+    out = {
+        canon[int(q)] for q, present in zip(qids, present_row) if present
+    }
+    with lock:
+        if pins:
+            out |= {
+                orig for stid, orig in canon.items()
+                if stid in pins and pins.get(stid)
+            }
+    return out
+
+
+def durations_from_mat(trace_ids, canon, qids, mat, pins: PinBank, lock):
+    """get_traces_duration result from the stacked durations kernel
+    output [4, nq], with pin-bank widening (shared by both stores)."""
+    by_tid = {
+        canon[int(q)]: TraceIdDuration(canon[int(q)], int(mx - mn), int(mn))
+        for q, f, mn, mx in zip(qids, mat[1], mat[2], mat[3])
+        if f
+    }
+    with lock:
+        if pins:
+            for stid, orig in canon.items():
+                if stid not in pins:
+                    continue
+                d = pinned_duration(orig, pins.get(stid), by_tid.get(orig))
+                if d is not None:
+                    by_tid[orig] = d
+    return [by_tid[t] for t in trace_ids if t in by_tid]
 
 
 class WriteSpanStore(abc.ABC):

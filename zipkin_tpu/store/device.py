@@ -737,50 +737,37 @@ def _bann_span_slot(state: StoreState):
     return slot, live
 
 
-def _dedup_topk_by_ts(tid, ts, valid, k: int):
-    """Dedup candidate rows by TRACE id (keeping each trace's max ts),
-    then take top-k traces by ts desc.
+def _topk_candidates(tid, ts, valid, k: int):
+    """Top-``k`` candidate rows by ts desc (validity folded into the
+    key; valid rows have ts >= 0 by construction). Returns ONE stacked
+    [3, k] i64 array (tid, ts, ok).
 
-    Returns (tids[k], tss[k], valid[k]). One trace with many matching
-    spans occupies exactly one of the ``k`` slots — the query layer's
-    result is trace ids, so per-span candidates must collapse before the
-    limit applies (the reference uniques ids after its index scan;
-    truncating per-span would let one hot trace crowd out the rest).
+    Callers dedup candidates by trace id on the host
+    (store.base.dedup_rank_limit) and re-query with a bigger ``k`` when
+    the window may have truncated a hot trace's spans — the top-k
+    primitive compiles in seconds where a full multi-key ring sort
+    compiles for minutes at 2^23 rows on TPU, and executes in ~1ms.
+    The escalation is exact: every trace missing from the candidate set
+    has its best span below ALL k candidates, so any ``limit`` distinct
+    traces found rank strictly above every excluded trace.
     """
-    # Sort by (validity, trace id, ts desc): invalid rows sort last as a
-    # group (no sentinel on the trace id itself — a live trace id may
-    # legitimately equal I64_MAX), so the first occurrence of each trace
-    # id in the valid prefix is that trace's most recent matching span.
-    # Valid ts are >= 0 so -ts never overflows.
-    invalid = ~valid
-    neg_ts = jnp.where(valid, -ts, 0)
-    order = jnp.lexsort((neg_ts, tid, invalid))
-    t_sorted = tid[order]
-    v_sorted = valid[order]
-    first = jnp.concatenate(
-        [jnp.ones(1, bool), t_sorted[1:] != t_sorted[:-1]]
-    )
-    rep_valid = first & v_sorted
-    ts_s, tid_s = ts[order], tid[order]
-    # Top-k by ts desc among the per-trace representatives.
-    neg_key = jnp.where(rep_valid, -ts_s, I64_MAX)
-    sel = jnp.argsort(neg_key)[:k]
-    return tid_s[sel], ts_s[sel], rep_valid[sel]
+    key = jnp.where(valid, ts, jnp.int64(-1))
+    vals, idx = jax.lax.top_k(key, k)
+    return jnp.stack([tid[idx], ts[idx], (vals >= 0).astype(jnp.int64)])
 
 
 @partial(jax.jit, static_argnums=(4,))
 def query_trace_ids_by_service(
-    state: StoreState, svc_id, name_lc_id, end_ts, limit: int
+    state: StoreState, svc_id, name_lc_id, end_ts, k: int
 ):
-    """Spans of a service (any annotation host), optional span-name match,
-    last_ts <= end_ts, top ``limit`` by last_ts desc.
+    """Candidate spans of a service (any annotation host), optional
+    span-name match, last_ts <= end_ts, top ``k`` by last_ts desc.
 
     Reference semantics: getTraceIdsByName (SpanStore.scala /
     CassieSpanStore.scala:366) with index ts = span last timestamp.
-
-    Returns ONE stacked [3, limit] i64 array (tids, tss, valid) — host
-    transfers through the tunnel pay a large per-array latency, so query
-    results cross as a single array.
+    Returns ONE stacked [3, k] i64 candidate array (see
+    _topk_candidates) — host transfers through the tunnel pay a large
+    per-array latency, so results cross as a single array.
     """
     slot, live = _ann_span_slot(state)
     ok = live & (state.ann_service_id == svc_id)
@@ -788,14 +775,13 @@ def query_trace_ids_by_service(
     ok &= (name_lc_id < 0) | (state.name_lc_id[slot] == name_lc_id)
     ts = state.ts_last[slot]
     ok &= (ts >= 0) & (ts <= end_ts)
-    tids, tss, valid = _dedup_topk_by_ts(state.trace_id[slot], ts, ok, limit)
-    return jnp.stack([tids, tss, valid.astype(jnp.int64)])
+    return _topk_candidates(state.trace_id[slot], ts, ok, k)
 
 
 @partial(jax.jit, static_argnums=(7,))
 def query_trace_ids_by_annotation(
     state: StoreState, svc_id, ann_value_id, bann_key_id, bann_value_id,
-    bann_value_id2, end_ts, limit: int,
+    bann_value_id2, end_ts, k: int,
 ):
     """Annotation-index query (CassieSpanStore AnnotationsIndex semantics).
 
@@ -837,8 +823,7 @@ def query_trace_ids_by_annotation(
     tid = jnp.concatenate([state.trace_id[a_slot], state.trace_id[b_slot]])
     ts = jnp.concatenate([a_ts, b_ts])
     ok = jnp.concatenate([a_ok, b_ok])
-    tids, tss, valid = _dedup_topk_by_ts(tid, ts, ok, limit)
-    return jnp.stack([tids, tss, valid.astype(jnp.int64)])
+    return _topk_candidates(tid, ts, ok, k)
 
 
 def _span_has_service(state: StoreState, span_slot, svc_id):
@@ -924,26 +909,31 @@ def gather_trace_rows(
     span_in, ann_in, bann_in = query_trace_membership(state, sorted_qids)
     c = state.config
 
-    key = jnp.where(span_in, state.row_gid, I64_MAX)
-    sel = jnp.argsort(key)[:k_spans]
+    def oldest_k(mask, write_pos, capacity, k):
+        """Indices of the k oldest matching ring slots (insertion
+        order). top_k on an i32 freshness key — a full i64 ring argsort
+        compiles for ~a minute per shape at 2^22 on TPU; top_k is
+        seconds, and k rows are all a trace read needs."""
+        head = (write_pos % capacity).astype(jnp.int32)
+        slots = jnp.arange(capacity, dtype=jnp.int32)
+        age = (slots - head) % jnp.int32(capacity)
+        key = jnp.where(mask, jnp.int32(capacity) - age, 0)
+        _, sel = jax.lax.top_k(key, k)
+        return sel
+
+    sel = oldest_k(span_in, state.write_pos, c.capacity, k_spans)
     span_mat = jnp.stack(
         [getattr(state, col)[sel].astype(jnp.int64) for col in SPAN_MAT_COLS]
     )
 
-    a_head = (state.ann_write_pos % c.ann_capacity).astype(jnp.int32)
-    a_slots = jnp.arange(c.ann_capacity, dtype=jnp.int32)
-    a_age = (a_slots - a_head) % c.ann_capacity
-    a_sel = jnp.argsort(jnp.where(ann_in, a_age, np.int32(2**31 - 1)))[:k_anns]
+    a_sel = oldest_k(ann_in, state.ann_write_pos, c.ann_capacity, k_anns)
     ann_mat = jnp.stack(
         [getattr(state, col)[a_sel].astype(jnp.int64) for col in ANN_MAT_COLS]
     )
     # Mask stale selections (when fewer than k_anns match).
     ann_mat = jnp.where(ann_in[a_sel][None, :], ann_mat, -1)
 
-    b_head = (state.bann_write_pos % c.bann_capacity).astype(jnp.int32)
-    b_slots = jnp.arange(c.bann_capacity, dtype=jnp.int32)
-    b_age = (b_slots - b_head) % c.bann_capacity
-    b_sel = jnp.argsort(jnp.where(bann_in, b_age, np.int32(2**31 - 1)))[:k_banns]
+    b_sel = oldest_k(bann_in, state.bann_write_pos, c.bann_capacity, k_banns)
     bann_mat = jnp.stack(
         [getattr(state, col)[b_sel].astype(jnp.int64)
          for col in BANN_MAT_COLS]

@@ -41,11 +41,14 @@ from zipkin_tpu.store.base import (
     SpanStore,
     TraceIdDuration,
     apply_pin_merges,
-    escalate_cap,
+    durations_from_mat,
+    exist_from_duration_mat,
     fill_pin,
+    gather_with_escalation,
     prune_ttls,
     resolve_annotation_query,
     should_index,
+    topk_ids_with_escalation,
 )
 
 _BATCH_MIN = 64
@@ -72,22 +75,6 @@ def name_lc_ids(batch: SpanBatch, dicts: DictionarySet,
             cache[nid] = lc
         out[i] = lc
     return out
-
-
-def _pinned_duration(trace_id: int, bank, existing=None):
-    """TraceIdDuration over the pinned spans, widened by any ring
-    result (partial eviction leaves the ring narrower than the bank)."""
-    ts = []
-    for s in bank or ():
-        if s.first_timestamp is not None:
-            ts.append(s.first_timestamp)
-            ts.append(s.last_timestamp)
-    if existing is not None:
-        ts.append(existing.start_timestamp)
-        ts.append(existing.start_timestamp + existing.duration)
-    if not ts:
-        return existing
-    return TraceIdDuration(trace_id, max(ts) - min(ts), min(ts))
 
 
 def decode_gathered(
@@ -464,15 +451,19 @@ class TpuSpanStore(SpanStore):
                 return []
         else:
             name_lc = -1
-        with self._rw.read():
-            mat = jax.device_get(dev.query_trace_ids_by_service(
-                self.state, svc, name_lc, end_ts, limit
-            ))
-        return [
-            IndexedTraceId(int(t), int(ts))
-            for t, ts, v in zip(mat[0], mat[1], mat[2])
-            if v
-        ]
+
+        def fetch(k):
+            with self._rw.read():
+                mat = jax.device_get(dev.query_trace_ids_by_service(
+                    self.state, svc, name_lc, end_ts, k
+                ))
+            cands = [(int(t), int(ts))
+                     for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
+            return cands, len(cands) >= k
+
+        return topk_ids_with_escalation(
+            limit, self.config.ann_capacity, fetch
+        )
 
     def get_trace_ids_by_annotation(
         self, service_name: str, annotation: str, value: Optional[bytes],
@@ -487,16 +478,21 @@ class TpuSpanStore(SpanStore):
         if resolved is None:
             return []
         ann_value, bann_key, bann_value, bann_value2 = resolved
-        with self._rw.read():
-            mat = jax.device_get(dev.query_trace_ids_by_annotation(
-                self.state, svc, ann_value, bann_key, bann_value, bann_value2,
-                end_ts, limit,
-            ))
-        return [
-            IndexedTraceId(int(t), int(ts))
-            for t, ts, v in zip(mat[0], mat[1], mat[2])
-            if v
-        ]
+
+        def fetch(k):
+            with self._rw.read():
+                mat = jax.device_get(dev.query_trace_ids_by_annotation(
+                    self.state, svc, ann_value, bann_key, bann_value,
+                    bann_value2, end_ts, k,
+                ))
+            cands = [(int(t), int(ts))
+                     for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
+            return cands, len(cands) >= k
+
+        c = self.config
+        return topk_ids_with_escalation(
+            limit, c.ann_capacity + c.bann_capacity, fetch
+        )
 
     # -- trace reads ----------------------------------------------------
 
@@ -518,42 +514,26 @@ class TpuSpanStore(SpanStore):
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
             mat = jax.device_get(dev.query_durations(self.state, qids))
-        out = {
-            canon[int(q)] for q, present in zip(qids, mat[0]) if present
-        }
-        with self._lock:
-            if self.pins:
-                out |= {
-                    orig for stid, orig in canon.items()
-                    if stid in self.pins and self.pins.get(stid)
-                }
-        return out
-
-    # Initial static caps for the device-side trace-row gather; escalate
-    # ×8 (bounded by ring capacity) when a read overflows them. Small
-    # caps keep the common case to one ~250KB transfer.
-    GATHER_K0 = 4096
+        return exist_from_duration_mat(canon, qids, mat[0], self.pins,
+                                       self._lock)
 
     def get_spans_by_trace_ids(self, trace_ids: Sequence[int]) -> List[List[Span]]:
         if not trace_ids:
             return []
         qids = self._sorted_qids(trace_ids)
-        c = self.config
-        k_s = min(self.GATHER_K0, c.capacity)
-        k_a = min(2 * self.GATHER_K0, c.ann_capacity)
-        k_b = min(self.GATHER_K0, c.bann_capacity)
         with self._rw.read():
             st = self.state
-            while True:
-                counts, span_mat, ann_mat, bann_mat = jax.device_get(
+
+            def fetch(k_s, k_a, k_b):
+                counts, s_m, a_m, b_m = jax.device_get(
                     dev.gather_trace_rows(st, qids, k_s, k_a, k_b)
                 )
                 n_s, n_a, n_b = (int(x) for x in counts)
-                if n_s <= k_s and n_a <= k_a and n_b <= k_b:
-                    break
-                k_s = escalate_cap(n_s, k_s, c.capacity)
-                k_a = escalate_cap(n_a, k_a, c.ann_capacity)
-                k_b = escalate_cap(n_b, k_b, c.bann_capacity)
+                return n_s, n_a, n_b, (n_s, n_a, n_b, s_m, a_m, b_m)
+
+            n_s, n_a, n_b, span_mat, ann_mat, bann_mat = (
+                gather_with_escalation(self.config, fetch)
+            )
         spans = self._decode_gathered(
             n_s, n_a, n_b, span_mat, ann_mat, bann_mat
         )
@@ -588,21 +568,8 @@ class TpuSpanStore(SpanStore):
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
             mat = jax.device_get(dev.query_durations(self.state, qids))
-        by_tid = {
-            canon[int(q)]: TraceIdDuration(canon[int(q)], int(mx - mn), int(mn))
-            for q, f, mn, mx in zip(qids, mat[1], mat[2], mat[3])
-            if f
-        }
-        with self._lock:
-            if self.pins:
-                for stid, orig in canon.items():
-                    if stid not in self.pins:
-                        continue
-                    d = _pinned_duration(orig, self.pins.get(stid),
-                                         by_tid.get(orig))
-                    if d is not None:
-                        by_tid[orig] = d
-        return [by_tid[t] for t in trace_ids if t in by_tid]
+        return durations_from_mat(trace_ids, canon, qids, mat, self.pins,
+                                  self._lock)
 
     # -- name catalogs --------------------------------------------------
 

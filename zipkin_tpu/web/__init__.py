@@ -1,0 +1,20 @@
+"""Static single-page UI (the zipkin-web role, minus the JVM).
+
+Reference: zipkin-web's mustache + Flight.js SPA — trace list + search
+(web/Main.scala:77-89, Handlers.scala:23-49), per-trace waterfall
+(component_ui/trace.js), dagre-d3 dependency graph fed by
+/api/dependencies (component_ui/dependencyGraph.js:1-40). Re-expressed
+as one dependency-free HTML file rendered from the same JSON API this
+framework already serves; no build system, no vendored JS.
+"""
+
+from __future__ import annotations
+
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def index_html() -> bytes:
+    with open(os.path.join(_HERE, "index.html"), "rb") as f:
+        return f.read()
