@@ -161,8 +161,6 @@ def _make_template(store, n_services: int, batch_traces: int):
         pad_spans=pad_spans, pad_anns=2 * pad_spans, pad_banns=pad_spans,
     )
     db0 = jax.device_put(db0)
-    # GOLDEN as a signed int64 (two's complement wraparound multiply).
-    golden = jnp.int64(GOLDEN - (1 << 64))
 
     @partial(jax.jit, donate_argnums=(0, 2))
     def fused_step(state, db, step):
@@ -170,8 +168,17 @@ def _make_template(store, n_services: int, batch_traces: int):
         device-carried step counter — a host scalar per step would pay a
         tunnel round trip each) and run the fused ingest. XOR keeps
         span_id = trace_id ^ node and the parent join structure intact;
-        time advances one minute per batch."""
-        salt = (step + 1) * golden
+        time advances one minute per batch.
+
+        The salt is splitmix64(step): a multiplicative salt correlates
+        with the golden-multiplied template trace ids and produces
+        structured cross-batch id collisions (~1 in 700 rows, measured),
+        which fabricate cross-trace parent joins in the benchmark data.
+        """
+        s = (step + 1).astype(jnp.uint64)
+        s = (s ^ (s >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+        s = (s ^ (s >> 27)) * jnp.uint64(0x94D049BB133111EB)
+        salt = (s ^ (s >> 31)).astype(jnp.int64)
         delta = step * jnp.int64(60_000_000)
 
         def shift(ts):
@@ -209,15 +216,22 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
         store, n_services, batch_traces
     )
 
+    def sync(x):
+        # A real barrier: device_get forces the D2H round trip.
+        # block_until_ready on tunneled devices has been observed to
+        # return before queued work executes, which would credit the
+        # stream with dispatch time only.
+        return float(jax.device_get(x))
+
     # Warm the compile caches on a throwaway state (donated away).
     _log(f"stream: compiling (capacity 2^{capacity_log2}, "
          f"{n_services} services, pallas={use_pallas})")
     wstate = dev.init_state(config)
     wstate, wstep = fused_step(wstate, db0, jnp.int64(0))
-    jax.block_until_ready(wstate.counters["spans_seen"])
+    sync(wstate.counters["spans_seen"])
     _log("stream: ingest compiled")
     wstate = dev.dep_archive_auto(wstate, pad_spans)
-    jax.block_until_ready(wstate.counters["spans_seen"])
+    sync(wstate.counters["spans_seen"])
     _log("stream: archive compiled")
     del wstate, wstep
 
@@ -228,7 +242,7 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
     n_steps = max(1, total_spans // pad_spans)
     archive_runs = 0
     t0 = time.perf_counter()
-    for _ in range(n_steps):
+    for i in range(n_steps):
         # Production archive policy (TpuSpanStore._maybe_archive). The
         # python-int arg matches the warmup call's aval exactly — a
         # jnp.int64 here would be a different aval and recompile the
@@ -239,8 +253,14 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
             archive_runs += 1
         state, step = fused_step(state, db0, step)
         wp += pad_spans
-    jax.block_until_ready(state.counters["spans_seen"])
+        if (i + 1) % 64 == 0:
+            # True barrier every 64 steps: bounds the async queue depth
+            # and keeps the measured rate honest (one D2H per ~7M spans
+            # amortizes to noise).
+            sync(state.counters["spans_seen"])
+    seen = sync(state.counters["spans_seen"])
     dt = time.perf_counter() - t0
+    assert seen == n_steps * pad_spans, (seen, n_steps * pad_spans)
     _log(f"stream: {n_steps * pad_spans} spans in {dt:.1f}s "
          f"({n_steps * pad_spans / dt / 1e6:.1f}M spans/s, "
          f"{archive_runs} archive passes)")
@@ -263,7 +283,7 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
     return store, stats
 
 
-def bench_tpu_queries(store, reps: int = 30):
+def bench_tpu_queries(store, reps: int = 12):
     """Configs #3-#5 + the get_trace_ids read path, through the public
     SpanStore API (wall-clock: device kernel + host materialization)."""
     _log("queries: starting")
@@ -371,6 +391,12 @@ def bench_compare_kernels(total_spans: int = 10_000_000):
 
 
 def main():
+    # SIGUSR1 → stack dump on stderr (the tunnel can block a device call
+    # indefinitely; this makes a stall diagnosable from outside).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--compare-kernels", action="store_true")

@@ -222,3 +222,26 @@ class TestSelfTracing:
         api.handle("GET", "/health", {})
         collector.flush()
         assert "zipkin-query" not in store.get_all_service_names()
+
+
+def test_negative_trace_id_roundtrip_through_hex_api():
+    """A trace id with the top bit set must survive query -> hex id ->
+    trace fetch / pin on an exact-compare store (regression: unsigned
+    parse left pins writing a ghost key)."""
+    from zipkin_tpu.ingest.collector import Collector
+    from zipkin_tpu.store.memory import InMemorySpanStore
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+
+    store = InMemorySpanStore()
+    api = ApiServer(QueryService(store), Collector(store, concurrency=1),
+                    self_trace=False)
+    ep = Endpoint(1, 80, "neg")
+    store.apply([Span(-123, "op", 1, None,
+                      (Annotation(5, "sr", ep), Annotation(9, "ss", ep)), ())])
+    status, body = api.handle("GET", "/api/query", {"serviceName": "neg"})
+    assert status == 200 and body["traceIds"] == ["ffffffffffffff85"]
+    status, spans = api.handle("GET", "/api/trace/ffffffffffffff85", {})
+    assert status == 200 and spans[0]["traceId"] == "ffffffffffffff85"
+    status, _ = api.handle("POST", "/api/pin/ffffffffffffff85/true", {})
+    assert status == 200
+    assert store.get_time_to_live(-123) > 1.0

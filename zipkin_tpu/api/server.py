@@ -317,10 +317,14 @@ class ApiServer:
 
 
 def _parse_trace_id(raw: str) -> int:
-    """Unsigned hex (the wire form) or signed decimal (legacy)."""
+    """Unsigned hex (the wire form) or signed decimal (legacy),
+    canonicalized to signed int64 — span_from_json does the same, and
+    stores that compare ids exactly (the in-memory reference) must see
+    the id the span was stored under, not its unsigned twin."""
     if raw.startswith("-"):
         return int(raw)
-    return int(raw, 16)
+    u = int(raw, 16)
+    return u - (1 << 64) if u >= (1 << 63) else u
 
 
 def _require(params, key):

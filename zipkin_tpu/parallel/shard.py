@@ -334,7 +334,7 @@ class ShardedSpanStore:
         def build():
             def fn(state, svc, name_lc, end_ts):
                 st = self._unstack(state)
-                mat = dev.query_trace_ids_by_service.__wrapped__(
+                mat = dev.query_trace_ids_by_service(
                     st, svc, name_lc, end_ts, limit
                 )
                 return mat[None]
@@ -351,7 +351,7 @@ class ShardedSpanStore:
         def build():
             def fn(state, svc, ann, bkey, bval, bval2, end_ts):
                 st = self._unstack(state)
-                mat = dev.query_trace_ids_by_annotation.__wrapped__(
+                mat = dev.query_trace_ids_by_annotation(
                     st, svc, ann, bkey, bval, bval2, end_ts, limit
                 )
                 return mat[None]
@@ -368,7 +368,7 @@ class ShardedSpanStore:
         def build():
             def fn(state, qids):
                 st = self._unstack(state)
-                mat = dev.query_durations.__wrapped__(st, qids)
+                mat = dev.query_durations(st, qids)
                 return jnp.stack([
                     jax.lax.pmax(mat[0], self.axis),
                     jax.lax.pmax(mat[1], self.axis),
@@ -387,7 +387,7 @@ class ShardedSpanStore:
         def build():
             def fn(state, qids):
                 st = self._unstack(state)
-                counts, s, a, b = dev.gather_trace_rows.__wrapped__(
+                counts, s, a, b = dev.gather_trace_rows(
                     st, qids, k_s, k_a, k_b
                 )
                 return counts[None], s[None], a[None], b[None]

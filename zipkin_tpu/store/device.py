@@ -503,28 +503,82 @@ def dep_archive_auto(state: "StoreState", incoming) -> "StoreState":
     return dep_archive_step(state, w_new)
 
 
-@jax.jit
+@partial(jax.jit, static_argnums=(8,))
+def _live_dep_impl(trace_id, span_id, parent_id, service_id, duration,
+                   flags, row_gid, dep_archived_gid, n_services: int):
+    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
+
+    live = row_gid >= 0
+    has_parent = (flags & jnp.int32(int(FLAG_HAS_PARENT))) != 0
+    probe = live & has_parent & (row_gid >= dep_archived_gid)
+    return dep_link_moments(
+        trace_id, span_id, parent_id, service_id, duration, live, probe,
+        n_services,
+    )
+
+
+def _live_dep_args(state: "StoreState"):
+    return (state.trace_id, state.span_id, state.parent_id,
+            state.service_id, state.duration, state.flags, state.row_gid,
+            state.dep_archived_gid, state.config.max_services)
+
+
 def live_dep_moments(state: "StoreState"):
     """Links whose child is live and not yet archived (gid >= watermark).
-    Disjoint from the archive bank; total links = combine of the two."""
-    live, children = _ring_children(state)
-    probe = children & (state.row_gid >= state.dep_archived_gid)
-    return dep_link_moments(
-        state.trace_id, state.span_id, state.parent_id, state.service_id,
-        state.duration, live, probe, state.config.max_services,
-    )
+    Disjoint from the archive bank; total links = combine of the two.
+    The jitted impl takes only the columns it reads (per-argument
+    dispatch overhead on tunneled devices)."""
+    return _live_dep_impl(*_live_dep_args(state))
 
 
-@jax.jit
+@partial(jax.jit, static_argnums=(10,))
+def _total_dep_impl(dep_moments, dep_banks, trace_id, span_id, parent_id,
+                    service_id, duration, flags, row_gid, dep_archived_gid,
+                    n_services: int):
+    banks = M.reduce_moments(dep_banks, axis=0)
+    live = _live_dep_impl(trace_id, span_id, parent_id, service_id,
+                          duration, flags, row_gid, dep_archived_gid,
+                          n_services)
+    return M.combine(M.combine(dep_moments, banks), live)
+
+
 def total_dep_moments(state: "StoreState"):
     """Tail + time-tagged banks + live: the complete link Moments bank."""
-    banks = M.reduce_moments(state.dep_banks, axis=0)
-    return M.combine(
-        M.combine(state.dep_moments, banks), live_dep_moments(state)
+    return _total_dep_impl(
+        state.dep_moments, state.dep_banks, *_live_dep_args(state)
     )
 
 
-@jax.jit
+@partial(jax.jit, static_argnums=(14,))
+def _dep_in_range_impl(dep_moments, dep_banks, dep_bank_ts, dep_overflow_ts,
+                       trace_id, span_id, parent_id, service_id, duration,
+                       flags, row_gid, dep_archived_gid, ts_first, ts_last,
+                       n_services: int, start_ts=None, end_ts=None):
+    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
+
+    start_ts = jnp.asarray(start_ts, jnp.int64)
+    end_ts = jnp.asarray(end_ts, jnp.int64)
+    bmin = dep_bank_ts[:, 0]
+    bmax = dep_bank_ts[:, 1]
+    sel = (bmin <= end_ts) & (bmax >= start_ts)
+    banks = jnp.where(sel[:, None, None], dep_banks, 0.0)
+    total = M.reduce_moments(banks, axis=0)
+    ov = (dep_overflow_ts[0] <= end_ts) & (dep_overflow_ts[1] >= start_ts)
+    total = M.combine(total, jnp.where(ov, dep_moments, 0.0))
+    # Live (unarchived) children: include when their ts range overlaps.
+    live = row_gid >= 0
+    has_parent = (flags & jnp.int32(int(FLAG_HAS_PARENT))) != 0
+    probe = live & has_parent & (row_gid >= dep_archived_gid)
+    l_min = jnp.where(probe & (ts_first >= 0), ts_first, I64_MAX).min()
+    l_max = jnp.where(probe & (ts_last >= 0), ts_last, I64_MIN).max()
+    l_ok = (l_min <= end_ts) & (l_max >= start_ts)
+    live_bank = dep_link_moments(
+        trace_id, span_id, parent_id, service_id, duration, live, probe,
+        n_services,
+    )
+    return M.combine(total, jnp.where(l_ok, live_bank, 0.0))
+
+
 def dep_moments_in_range(state: "StoreState", start_ts, end_ts):
     """Link Moments restricted to archive banks (and the live window)
     whose children's ts range overlaps [start_ts, end_ts] — the
@@ -532,31 +586,14 @@ def dep_moments_in_range(state: "StoreState", start_ts, end_ts):
     (Aggregates.scala:26-31). Bucket-granular: a bank overlapping the
     window contributes whole (the reference's hourly Dependencies rows
     are equally coarse, Dependencies.scala:59-67)."""
-    start_ts = jnp.asarray(start_ts, jnp.int64)
-    end_ts = jnp.asarray(end_ts, jnp.int64)
-    bmin = state.dep_bank_ts[:, 0]
-    bmax = state.dep_bank_ts[:, 1]
-    sel = (bmin <= end_ts) & (bmax >= start_ts)
-    banks = jnp.where(sel[:, None, None], state.dep_banks, 0.0)
-    total = M.reduce_moments(banks, axis=0)
-    ov = (
-        (state.dep_overflow_ts[0] <= end_ts)
-        & (state.dep_overflow_ts[1] >= start_ts)
+    return _dep_in_range_impl(
+        state.dep_moments, state.dep_banks, state.dep_bank_ts,
+        state.dep_overflow_ts, state.trace_id, state.span_id,
+        state.parent_id, state.service_id, state.duration, state.flags,
+        state.row_gid, state.dep_archived_gid, state.ts_first,
+        state.ts_last, state.config.max_services,
+        start_ts=start_ts, end_ts=end_ts,
     )
-    total = M.combine(total, jnp.where(ov, state.dep_moments, 0.0))
-    # Live (unarchived) children: include when their ts range overlaps.
-    live, children = _ring_children(state)
-    probe = children & (state.row_gid >= state.dep_archived_gid)
-    l_min = jnp.where(probe & (state.ts_first >= 0), state.ts_first,
-                      I64_MAX).min()
-    l_max = jnp.where(probe & (state.ts_last >= 0), state.ts_last,
-                      I64_MIN).max()
-    l_ok = (l_min <= end_ts) & (l_max >= start_ts)
-    live_bank = dep_link_moments(
-        state.trace_id, state.span_id, state.parent_id, state.service_id,
-        state.duration, live, probe, state.config.max_services,
-    )
-    return M.combine(total, jnp.where(l_ok, live_bank, 0.0))
 
 
 # ---------------------------------------------------------------------------
@@ -720,23 +757,6 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
 # ---------------------------------------------------------------------------
 
 
-def _ann_span_slot(state: StoreState):
-    """Per annotation-ring row: (span slot, row-still-live mask)."""
-    c = state.config
-    slot = (state.ann_gid % c.capacity).astype(jnp.int32)
-    slot = jnp.clip(slot, 0, c.capacity - 1)
-    live = (state.ann_gid >= 0) & (state.row_gid[slot] == state.ann_gid)
-    return slot, live
-
-
-def _bann_span_slot(state: StoreState):
-    c = state.config
-    slot = (state.bann_gid % c.capacity).astype(jnp.int32)
-    slot = jnp.clip(slot, 0, c.capacity - 1)
-    live = (state.bann_gid >= 0) & (state.row_gid[slot] == state.bann_gid)
-    return slot, live
-
-
 def _topk_candidates(tid, ts, valid, k: int):
     """Top-``k`` candidate rows by ts desc (validity folded into the
     key; valid rows have ts >= 0 by construction). Returns ONE stacked
@@ -756,7 +776,22 @@ def _topk_candidates(tid, ts, valid, k: int):
     return jnp.stack([tid[idx], ts[idx], (vals >= 0).astype(jnp.int64)])
 
 
-@partial(jax.jit, static_argnums=(4,))
+@partial(jax.jit, static_argnums=(7, 8))
+def _q_by_service_impl(
+    ann_gid, ann_service_id, row_gid, indexable, name_lc_col, trace_id,
+    ts_last, capacity: int, k: int, svc_id, name_lc_id, end_ts,
+):
+    slot = (ann_gid % capacity).astype(jnp.int32)
+    slot = jnp.clip(slot, 0, capacity - 1)
+    live = (ann_gid >= 0) & (row_gid[slot] == ann_gid)
+    ok = live & (ann_service_id == svc_id)
+    ok &= indexable[slot]
+    ok &= (name_lc_id < 0) | (name_lc_col[slot] == name_lc_id)
+    ts = ts_last[slot]
+    ok &= (ts >= 0) & (ts <= end_ts)
+    return _topk_candidates(trace_id[slot], ts, ok, k)
+
+
 def query_trace_ids_by_service(
     state: StoreState, svc_id, name_lc_id, end_ts, k: int
 ):
@@ -766,19 +801,70 @@ def query_trace_ids_by_service(
     Reference semantics: getTraceIdsByName (SpanStore.scala /
     CassieSpanStore.scala:366) with index ts = span last timestamp.
     Returns ONE stacked [3, k] i64 candidate array (see
-    _topk_candidates) — host transfers through the tunnel pay a large
-    per-array latency, so results cross as a single array.
+    _topk_candidates). The jitted impl takes ONLY the seven columns it
+    reads — tunneled devices charge per argument buffer per dispatch,
+    and passing the whole 40-leaf state pytree made every index query
+    pay ~0.8s of pure argument overhead.
     """
-    slot, live = _ann_span_slot(state)
-    ok = live & (state.ann_service_id == svc_id)
-    ok &= state.indexable[slot]
-    ok &= (name_lc_id < 0) | (state.name_lc_id[slot] == name_lc_id)
-    ts = state.ts_last[slot]
-    ok &= (ts >= 0) & (ts <= end_ts)
-    return _topk_candidates(state.trace_id[slot], ts, ok, k)
+    return _q_by_service_impl(
+        state.ann_gid, state.ann_service_id, state.row_gid,
+        state.indexable, state.name_lc_id, state.trace_id, state.ts_last,
+        state.config.capacity, k, svc_id, name_lc_id, end_ts,
+    )
 
 
-@partial(jax.jit, static_argnums=(7,))
+@partial(jax.jit, static_argnums=(10, 11))
+def _q_by_annotation_impl(
+    ann_gid, ann_service_id, ann_value_col, row_gid, indexable, ts_last,
+    trace_id, bann_gid, bann_key_col, bann_value_col,
+    capacity: int, k: int,
+    svc_id, ann_value_id, bann_key_id, bann_value_id, bann_value_id2,
+    end_ts,
+):
+    def span_slot(gid):
+        slot = (gid % capacity).astype(jnp.int32)
+        slot = jnp.clip(slot, 0, capacity - 1)
+        return slot, (gid >= 0) & (row_gid[slot] == gid)
+
+    a_slot, a_live = span_slot(ann_gid)
+    # Build: which span slots have an annotation hosted by svc_id.
+    hit = a_live & (ann_service_id == svc_id)
+    per_slot = jnp.zeros(capacity + 1, bool)
+    per_slot = per_slot.at[jnp.where(hit, a_slot, capacity)].set(
+        True, mode="drop"
+    )[:-1]
+
+    a_ok = (
+        a_live
+        & (ann_value_col == ann_value_id) & (ann_value_id >= 0)
+        & indexable[a_slot]
+        & per_slot[a_slot]
+    )
+    a_ts = ts_last[a_slot]
+    a_ok &= (a_ts >= 0) & (a_ts <= end_ts)
+
+    b_slot, b_live = span_slot(bann_gid)
+    value_free = (bann_value_id < 0) & (bann_value_id2 < 0)
+    value_hit = (
+        ((bann_value_id >= 0) & (bann_value_col == bann_value_id))
+        | ((bann_value_id2 >= 0) & (bann_value_col == bann_value_id2))
+    )
+    b_ok = (
+        b_live
+        & (bann_key_col == bann_key_id) & (bann_key_id >= 0)
+        & (value_free | value_hit)
+        & indexable[b_slot]
+        & per_slot[b_slot]
+    )
+    b_ts = ts_last[b_slot]
+    b_ok &= (b_ts >= 0) & (b_ts <= end_ts)
+
+    tid = jnp.concatenate([trace_id[a_slot], trace_id[b_slot]])
+    ts = jnp.concatenate([a_ts, b_ts])
+    ok = jnp.concatenate([a_ok, b_ok])
+    return _topk_candidates(tid, ts, ok, k)
+
+
 def query_trace_ids_by_annotation(
     state: StoreState, svc_id, ann_value_id, bann_key_id, bann_value_id,
     bann_value_id2, end_ts, k: int,
@@ -789,76 +875,30 @@ def query_trace_ids_by_annotation(
     ``ann_value_id``, OR a binary annotation with ``bann_key_id``
     (and one of ``bann_value_id``/``bann_value_id2`` if >= 0 — two slots
     because the host dictionary may hold a value in both str and bytes
-    form). Pass -1 to disable either side.
+    form). Pass -1 to disable either side. The jitted impl takes only
+    the ten columns it reads (see query_trace_ids_by_service).
     """
-    c = state.config
-    # Annotation-value candidates.
-    a_slot, a_live = _ann_span_slot(state)
-    a_ok = (
-        a_live
-        & (state.ann_value_id == ann_value_id) & (ann_value_id >= 0)
-        & state.indexable[a_slot]
+    return _q_by_annotation_impl(
+        state.ann_gid, state.ann_service_id, state.ann_value_id,
+        state.row_gid, state.indexable, state.ts_last, state.trace_id,
+        state.bann_gid, state.bann_key_id, state.bann_value_id,
+        state.config.capacity, k,
+        svc_id, ann_value_id, bann_key_id, bann_value_id, bann_value_id2,
+        end_ts,
     )
-    a_svc_ok = _span_has_service(state, a_slot, svc_id)
-    a_ok &= a_svc_ok
-    a_ts = state.ts_last[a_slot]
-    a_ok &= (a_ts >= 0) & (a_ts <= end_ts)
-    # Binary-annotation candidates.
-    b_slot, b_live = _bann_span_slot(state)
-    value_free = (bann_value_id < 0) & (bann_value_id2 < 0)
-    value_hit = (
-        ((bann_value_id >= 0) & (state.bann_value_id == bann_value_id))
-        | ((bann_value_id2 >= 0) & (state.bann_value_id == bann_value_id2))
-    )
-    b_ok = (
-        b_live
-        & (state.bann_key_id == bann_key_id) & (bann_key_id >= 0)
-        & (value_free | value_hit)
-        & state.indexable[b_slot]
-    )
-    b_ok &= _span_has_service(state, b_slot, svc_id)
-    b_ts = state.ts_last[b_slot]
-    b_ok &= (b_ts >= 0) & (b_ts <= end_ts)
-
-    tid = jnp.concatenate([state.trace_id[a_slot], state.trace_id[b_slot]])
-    ts = jnp.concatenate([a_ts, b_ts])
-    ok = jnp.concatenate([a_ok, b_ok])
-    return _topk_candidates(tid, ts, ok, k)
-
-
-def _span_has_service(state: StoreState, span_slot, svc_id):
-    """Per-row: does the span at ``span_slot`` have ``svc_id`` among its
-    annotation services? Computed via a per-slot service bitset-free
-    membership pass over the annotation ring."""
-    # Build: which slots have an annotation with svc_id.
-    a_slot, a_live = _ann_span_slot(state)
-    hit = a_live & (state.ann_service_id == svc_id)
-    per_slot = jnp.zeros(state.config.capacity + 1, bool)
-    per_slot = per_slot.at[jnp.where(hit, a_slot, state.config.capacity)].set(
-        True, mode="drop"
-    )[:-1]
-    return per_slot[span_slot]
 
 
 @jax.jit
-def query_durations(state: StoreState, sorted_qids):
-    """Per queried trace id, ONE stacked [4, nq] i64 array:
-    (present, found, min first_ts, max last_ts).
-
-    ``present`` = any live row carries the id (traces_exist semantics);
-    ``found`` additionally requires a timestamp (getTracesDuration,
-    Index.scala:26: duration = max(last) - min(first)). ``sorted_qids``
-    must be ascending (host sorts).
-    """
+def _q_durations_impl(trace_id, row_gid, ts_first, ts_last, sorted_qids):
     nq = sorted_qids.shape[0]
-    live = state.row_gid >= 0
-    pos = jnp.searchsorted(sorted_qids, state.trace_id)
+    live = row_gid >= 0
+    pos = jnp.searchsorted(sorted_qids, trace_id)
     pos_c = jnp.clip(pos, 0, nq - 1)
-    match = live & (sorted_qids[pos_c] == state.trace_id)
+    match = live & (sorted_qids[pos_c] == trace_id)
     seg = jnp.where(match, pos_c, nq)
-    has_ts = match & (state.ts_first >= 0)
-    firsts = jnp.where(has_ts, state.ts_first, I64_MAX)
-    lasts = jnp.where(has_ts, state.ts_last, I64_MIN)
+    has_ts = match & (ts_first >= 0)
+    firsts = jnp.where(has_ts, ts_first, I64_MAX)
+    lasts = jnp.where(has_ts, ts_last, I64_MIN)
     min_first = (
         jnp.full(nq + 1, I64_MAX, jnp.int64).at[seg].min(firsts, mode="drop")[:nq]
     )
@@ -876,6 +916,22 @@ def query_durations(state: StoreState, sorted_qids):
     ])
 
 
+def query_durations(state: StoreState, sorted_qids):
+    """Per queried trace id, ONE stacked [4, nq] i64 array:
+    (present, found, min first_ts, max last_ts).
+
+    ``present`` = any live row carries the id (traces_exist semantics);
+    ``found`` additionally requires a timestamp (getTracesDuration,
+    Index.scala:26: duration = max(last) - min(first)). ``sorted_qids``
+    must be ascending (host sorts). The jitted impl takes only the four
+    columns it reads (see query_trace_ids_by_service).
+    """
+    return _q_durations_impl(
+        state.trace_id, state.row_gid, state.ts_first, state.ts_last,
+        sorted_qids,
+    )
+
+
 # Column order of the stacked matrices gather_trace_rows returns; the
 # host decodes by these names (row_gid last in SPAN_MAT_COLS).
 SPAN_MAT_COLS = (
@@ -889,7 +945,63 @@ BANN_MAT_COLS = ("bann_gid", "bann_key_id", "bann_value_id", "bann_type",
                  "bann_service_id", "bann_endpoint_id")
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4))
+@partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12))
+def _gather_impl(
+    span_cols, ann_cols, bann_cols, sorted_qids,
+    write_pos, ann_write_pos, bann_write_pos,
+    capacity: int, ann_capacity: int, bann_capacity: int,
+    k_spans: int, k_anns: int, k_banns: int,
+):
+    trace_id = span_cols[0]
+    row_gid = span_cols[-1]
+    ann_gid = ann_cols[0]
+    bann_gid = bann_cols[0]
+
+    nq = sorted_qids.shape[0]
+    live = row_gid >= 0
+    pos = jnp.clip(jnp.searchsorted(sorted_qids, trace_id), 0, nq - 1)
+    span_in = live & (sorted_qids[pos] == trace_id)
+
+    a_slot = jnp.clip((ann_gid % capacity).astype(jnp.int32), 0,
+                      capacity - 1)
+    ann_in = (ann_gid >= 0) & (row_gid[a_slot] == ann_gid) & span_in[a_slot]
+    b_slot = jnp.clip((bann_gid % capacity).astype(jnp.int32), 0,
+                      capacity - 1)
+    bann_in = ((bann_gid >= 0) & (row_gid[b_slot] == bann_gid)
+               & span_in[b_slot])
+
+    def oldest_k(mask, wp, cap, k):
+        """Indices of the k oldest matching ring slots (insertion
+        order). top_k on an i32 freshness key — a full i64 ring argsort
+        compiles for ~a minute per shape at 2^22 on TPU; top_k is
+        seconds, and k rows are all a trace read needs."""
+        head = (wp % cap).astype(jnp.int32)
+        slots = jnp.arange(cap, dtype=jnp.int32)
+        age = (slots - head) % jnp.int32(cap)
+        key = jnp.where(mask, jnp.int32(cap) - age, 0)
+        _, sel = jax.lax.top_k(key, k)
+        return sel
+
+    sel = oldest_k(span_in, write_pos, capacity, k_spans)
+    span_mat = jnp.stack([c[sel].astype(jnp.int64) for c in span_cols])
+
+    a_sel = oldest_k(ann_in, ann_write_pos, ann_capacity, k_anns)
+    ann_mat = jnp.stack([c[a_sel].astype(jnp.int64) for c in ann_cols])
+    # Mask stale selections (when fewer than k_anns match).
+    ann_mat = jnp.where(ann_in[a_sel][None, :], ann_mat, -1)
+
+    b_sel = oldest_k(bann_in, bann_write_pos, bann_capacity, k_banns)
+    bann_mat = jnp.stack([c[b_sel].astype(jnp.int64) for c in bann_cols])
+    bann_mat = jnp.where(bann_in[b_sel][None, :], bann_mat, -1)
+
+    counts = jnp.stack([
+        span_in.sum(dtype=jnp.int64),
+        ann_in.sum(dtype=jnp.int64),
+        bann_in.sum(dtype=jnp.int64),
+    ])
+    return counts, span_mat, ann_mat, bann_mat
+
+
 def gather_trace_rows(
     state: StoreState, sorted_qids, k_spans: int, k_anns: int, k_banns: int,
 ):
@@ -904,69 +1016,18 @@ def gather_trace_rows(
     by ring age so per-span annotation insert order survives. Rows
     beyond the static ``k_*`` caps are dropped — counts tell the caller
     to escalate caps and retry (the maxTraceCols-style guard,
-    CassieSpanStore.scala:50).
+    CassieSpanStore.scala:50). The jitted impl takes only the columns
+    it gathers (per-argument dispatch overhead on tunneled devices).
     """
-    span_in, ann_in, bann_in = query_trace_membership(state, sorted_qids)
     c = state.config
-
-    def oldest_k(mask, write_pos, capacity, k):
-        """Indices of the k oldest matching ring slots (insertion
-        order). top_k on an i32 freshness key — a full i64 ring argsort
-        compiles for ~a minute per shape at 2^22 on TPU; top_k is
-        seconds, and k rows are all a trace read needs."""
-        head = (write_pos % capacity).astype(jnp.int32)
-        slots = jnp.arange(capacity, dtype=jnp.int32)
-        age = (slots - head) % jnp.int32(capacity)
-        key = jnp.where(mask, jnp.int32(capacity) - age, 0)
-        _, sel = jax.lax.top_k(key, k)
-        return sel
-
-    sel = oldest_k(span_in, state.write_pos, c.capacity, k_spans)
-    span_mat = jnp.stack(
-        [getattr(state, col)[sel].astype(jnp.int64) for col in SPAN_MAT_COLS]
+    return _gather_impl(
+        tuple(getattr(state, col) for col in SPAN_MAT_COLS),
+        tuple(getattr(state, col) for col in ANN_MAT_COLS),
+        tuple(getattr(state, col) for col in BANN_MAT_COLS),
+        sorted_qids,
+        state.write_pos, state.ann_write_pos, state.bann_write_pos,
+        c.capacity, c.ann_capacity, c.bann_capacity,
+        k_spans, k_anns, k_banns,
     )
 
-    a_sel = oldest_k(ann_in, state.ann_write_pos, c.ann_capacity, k_anns)
-    ann_mat = jnp.stack(
-        [getattr(state, col)[a_sel].astype(jnp.int64) for col in ANN_MAT_COLS]
-    )
-    # Mask stale selections (when fewer than k_anns match).
-    ann_mat = jnp.where(ann_in[a_sel][None, :], ann_mat, -1)
 
-    b_sel = oldest_k(bann_in, state.bann_write_pos, c.bann_capacity, k_banns)
-    bann_mat = jnp.stack(
-        [getattr(state, col)[b_sel].astype(jnp.int64)
-         for col in BANN_MAT_COLS]
-    )
-    bann_mat = jnp.where(bann_in[b_sel][None, :], bann_mat, -1)
-
-    counts = jnp.stack([
-        span_in.sum(dtype=jnp.int64),
-        ann_in.sum(dtype=jnp.int64),
-        bann_in.sum(dtype=jnp.int64),
-    ])
-    return counts, span_mat, ann_mat, bann_mat
-
-
-@jax.jit
-def query_trace_membership(state: StoreState, sorted_qids):
-    """Bool masks: (span rows, ann rows, bann rows) belonging to the ids."""
-    nq = sorted_qids.shape[0]
-    live = state.row_gid >= 0
-    pos = jnp.clip(jnp.searchsorted(sorted_qids, state.trace_id), 0, nq - 1)
-    span_in = live & (sorted_qids[pos] == state.trace_id)
-    a_slot, a_live = _ann_span_slot(state)
-    ann_in = a_live & span_in[a_slot]
-    b_slot, b_live = _bann_span_slot(state)
-    bann_in = b_live & span_in[b_slot]
-    return span_in, ann_in, bann_in
-
-
-@jax.jit
-def query_service_stats(state: StoreState):
-    """(service present mask, span-name presence, dep moments) snapshot."""
-    return (
-        state.ann_svc_counts > 0,
-        state.name_presence > 0,
-        state.dep_moments,
-    )
