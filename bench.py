@@ -50,7 +50,6 @@ def _log(msg: str) -> None:
 
 _T0 = time.perf_counter()
 
-GOLDEN = 0x9E3779B97F4A7C15
 SPT = 7  # spans per generated trace
 
 

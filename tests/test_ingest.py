@@ -214,3 +214,70 @@ class TestCollector:
             col.control_tick(now)
             now += 1.0
         assert col.sampler.rate < 1.0
+
+
+class TestKafkaSink:
+    def _sink(self, **kw):
+        from zipkin_tpu.ingest.kafka import KafkaSpanSink
+
+        sent = []
+        sink = KafkaSpanSink(lambda topic, value: sent.append((topic, value)),
+                             **kw)
+        return sink, sent
+
+    def test_publishes_thrift_spans_roundtrip(self):
+        from zipkin_tpu.ingest.kafka import KafkaSpanReceiver
+        from zipkin_tpu.tracegen import generate_traces
+        from zipkin_tpu.wire.thrift import spans_from_bytes
+
+        spans = [s for t in generate_traces(n_traces=5, max_depth=3)
+                 for s in t]
+        sink, sent = self._sink()
+        sink.apply(spans)
+        assert sink.stats["published"] == len(spans)
+        assert all(topic == "zipkin" for topic, _ in sent)
+        # The published bytes ARE the receiver's wire format: feed them
+        # back through KafkaSpanReceiver and get the same spans.
+        got = []
+        rx = KafkaSpanReceiver(got.extend, [[v for _, v in sent]])
+        rx.run()
+        assert got == spans
+
+    def test_batch_mode_one_message(self):
+        from zipkin_tpu.tracegen import generate_traces
+        from zipkin_tpu.wire.thrift import spans_from_bytes
+
+        spans = [s for t in generate_traces(n_traces=3, max_depth=3)
+                 for s in t]
+        sink, sent = self._sink(batch=True)
+        sink.apply(spans)
+        assert len(sent) == 1
+        assert spans_from_bytes(sent[0][1]) == spans
+
+    def test_producer_errors_counted_not_raised(self):
+        from zipkin_tpu.ingest.kafka import KafkaSpanSink
+        from zipkin_tpu.tracegen import generate_traces
+
+        def boom(topic, value):
+            raise RuntimeError("broker down")
+
+        sink = KafkaSpanSink(boom)
+        spans = [s for t in generate_traces(n_traces=2, max_depth=2)
+                 for s in t]
+        sink.apply(spans)  # must not raise
+        assert sink.stats["errors"] == len(spans)
+
+    def test_fanout_member(self):
+        from zipkin_tpu.store.base import FanoutWriteSpanStore
+        from zipkin_tpu.store.memory import InMemorySpanStore
+        from zipkin_tpu.tracegen import generate_traces
+
+        sink, sent = self._sink()
+        mem = InMemorySpanStore()
+        fan = FanoutWriteSpanStore(mem, sink)
+        spans = [s for t in generate_traces(n_traces=2, max_depth=2)
+                 for s in t]
+        fan.apply(spans)
+        fan.set_time_to_live(spans[0].trace_id, 99.0)
+        assert len(mem.spans) == len(spans) and len(sent) == len(spans)
+        fan.close()

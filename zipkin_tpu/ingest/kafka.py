@@ -1,13 +1,17 @@
-"""Kafka-style streaming receiver (consumer-agnostic).
+"""Kafka-style streaming receiver + producer sink (client-agnostic).
 
 Reference: zipkin-receiver-kafka (KafkaProcessor.scala:25,
 KafkaStreamProcessor.scala:8) — N consumer streams, each decoding thrift
-span payloads and pushing into the collector with retry-on-pushback.
+span payloads and pushing into the collector with retry-on-pushback —
+and zipkin-kafka's producer sink (collector/Kafka.scala: a
+``Service[Span, Unit]`` publishing thrift-encoded spans to a topic).
 
 No kafka client library ships in this environment, so the transport is
 injected: a *consumer* here is any iterable of ``bytes`` messages (a
-real kafka consumer's message-value iterator fits directly). The decode
-and pushback semantics are the receiver's.
+real kafka consumer's message-value iterator fits directly), and a
+*producer* is any ``send(topic, bytes)`` callable (kafka-python's
+``KafkaProducer.send`` fits directly). The decode/encode and pushback
+semantics are this module's.
 """
 
 from __future__ import annotations
@@ -89,3 +93,55 @@ class KafkaSpanReceiver:
             t.start()
         for t in self._threads:
             t.join()
+
+
+class KafkaSpanSink:
+    """Producer side: publish spans to a kafka topic as thrift bytes —
+    the zipkin-kafka role (collector/Kafka.scala's Service[Span, Unit]
+    with its SpanEncoder), so a collector can fan spans out to a topic
+    (e.g. for an offline aggregation consumer) alongside storage.
+
+    ``producer``: any ``send(topic: str, value: bytes)`` callable —
+    kafka-python's ``KafkaProducer.send`` fits directly; tests inject a
+    list-appender. Usable as a FanoutWriteSpanStore member: ``apply``
+    publishes, ``set_time_to_live`` is a no-op (a topic has no per-trace
+    retention; parity with the reference sink, which only writes).
+    """
+
+    def __init__(self, producer: Callable[[str, bytes], object],
+                 topic: str = "zipkin",
+                 batch: bool = False):
+        from zipkin_tpu.wire.thrift import span_to_bytes
+
+        self._encode = span_to_bytes
+        self.producer = producer
+        self.topic = topic
+        self.batch = batch
+        self.stats = {"published": 0, "errors": 0}
+
+    def apply(self, spans: Sequence[Span]) -> None:
+        if self.batch:
+            # One message per batch (concatenated Span structs — the
+            # form KafkaSpanReceiver/spans_from_bytes decodes).
+            payload = b"".join(self._encode(s) for s in spans)
+            self._send(payload, len(spans))
+            return
+        for s in spans:
+            self._send(self._encode(s), 1)
+
+    def _send(self, payload: bytes, n: int) -> None:
+        try:
+            self.producer(self.topic, payload)
+            self.stats["published"] += n
+        except Exception:
+            # The reference sink swallows-and-counts producer errors
+            # rather than failing the write pipeline.
+            self.stats["errors"] += n
+
+    def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
+        pass
+
+    def close(self) -> None:
+        flush = getattr(self.producer, "flush", None)
+        if callable(flush):
+            flush()

@@ -553,7 +553,7 @@ def total_dep_moments(state: "StoreState"):
 def _dep_in_range_impl(dep_moments, dep_banks, dep_bank_ts, dep_overflow_ts,
                        trace_id, span_id, parent_id, service_id, duration,
                        flags, row_gid, dep_archived_gid, ts_first, ts_last,
-                       n_services: int, start_ts=None, end_ts=None):
+                       n_services: int, *, start_ts, end_ts):
     from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
 
     start_ts = jnp.asarray(start_ts, jnp.int64)
@@ -757,6 +757,14 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
 # ---------------------------------------------------------------------------
 
 
+def _span_slot(gid, row_gid, capacity: int):
+    """Per annotation/binary ring row: (owning span's ring slot,
+    row-still-live mask). Liveness = the span row at the slot still
+    carries the gid this annotation was written under."""
+    slot = jnp.clip((gid % capacity).astype(jnp.int32), 0, capacity - 1)
+    return slot, (gid >= 0) & (row_gid[slot] == gid)
+
+
 def _topk_candidates(tid, ts, valid, k: int):
     """Top-``k`` candidate rows by ts desc (validity folded into the
     key; valid rows have ts >= 0 by construction). Returns ONE stacked
@@ -781,9 +789,7 @@ def _q_by_service_impl(
     ann_gid, ann_service_id, row_gid, indexable, name_lc_col, trace_id,
     ts_last, capacity: int, k: int, svc_id, name_lc_id, end_ts,
 ):
-    slot = (ann_gid % capacity).astype(jnp.int32)
-    slot = jnp.clip(slot, 0, capacity - 1)
-    live = (ann_gid >= 0) & (row_gid[slot] == ann_gid)
+    slot, live = _span_slot(ann_gid, row_gid, capacity)
     ok = live & (ann_service_id == svc_id)
     ok &= indexable[slot]
     ok &= (name_lc_id < 0) | (name_lc_col[slot] == name_lc_id)
@@ -821,12 +827,7 @@ def _q_by_annotation_impl(
     svc_id, ann_value_id, bann_key_id, bann_value_id, bann_value_id2,
     end_ts,
 ):
-    def span_slot(gid):
-        slot = (gid % capacity).astype(jnp.int32)
-        slot = jnp.clip(slot, 0, capacity - 1)
-        return slot, (gid >= 0) & (row_gid[slot] == gid)
-
-    a_slot, a_live = span_slot(ann_gid)
+    a_slot, a_live = _span_slot(ann_gid, row_gid, capacity)
     # Build: which span slots have an annotation hosted by svc_id.
     hit = a_live & (ann_service_id == svc_id)
     per_slot = jnp.zeros(capacity + 1, bool)
@@ -843,7 +844,7 @@ def _q_by_annotation_impl(
     a_ts = ts_last[a_slot]
     a_ok &= (a_ts >= 0) & (a_ts <= end_ts)
 
-    b_slot, b_live = span_slot(bann_gid)
+    b_slot, b_live = _span_slot(bann_gid, row_gid, capacity)
     value_free = (bann_value_id < 0) & (bann_value_id2 < 0)
     value_hit = (
         ((bann_value_id >= 0) & (bann_value_col == bann_value_id))
@@ -962,13 +963,10 @@ def _gather_impl(
     pos = jnp.clip(jnp.searchsorted(sorted_qids, trace_id), 0, nq - 1)
     span_in = live & (sorted_qids[pos] == trace_id)
 
-    a_slot = jnp.clip((ann_gid % capacity).astype(jnp.int32), 0,
-                      capacity - 1)
-    ann_in = (ann_gid >= 0) & (row_gid[a_slot] == ann_gid) & span_in[a_slot]
-    b_slot = jnp.clip((bann_gid % capacity).astype(jnp.int32), 0,
-                      capacity - 1)
-    bann_in = ((bann_gid >= 0) & (row_gid[b_slot] == bann_gid)
-               & span_in[b_slot])
+    a_slot, a_live = _span_slot(ann_gid, row_gid, capacity)
+    ann_in = a_live & span_in[a_slot]
+    b_slot, b_live = _span_slot(bann_gid, row_gid, capacity)
+    bann_in = b_live & span_in[b_slot]
 
     def oldest_k(mask, wp, cap, k):
         """Indices of the k oldest matching ring slots (insertion
