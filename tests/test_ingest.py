@@ -281,3 +281,30 @@ class TestKafkaSink:
         fan.set_time_to_live(spans[0].trace_id, 99.0)
         assert len(mem.spans) == len(spans) and len(sent) == len(spans)
         fan.close()
+
+    def test_async_producer_future_errors(self):
+        """kafka-python-style async producers report delivery on the
+        returned future from an IO thread; a down broker must count as
+        errors, not phantom publishes."""
+        from zipkin_tpu.ingest.kafka import KafkaSpanSink
+        from zipkin_tpu.tracegen import generate_traces
+
+        class FakeFuture:
+            def __init__(self, ok):
+                self.ok = ok
+
+            def add_callback(self, fn):
+                if self.ok:
+                    fn(None)
+
+            def add_errback(self, fn):
+                if not self.ok:
+                    fn(RuntimeError("broker down"))
+
+        outcomes = iter([True, False, True])
+        sink = KafkaSpanSink(lambda t, v: FakeFuture(next(outcomes)))
+        spans = [s for t in generate_traces(n_traces=3, max_depth=1)
+                 for s in t][:3]
+        for s in spans:
+            sink.apply([s])
+        assert sink.stats == {"published": 2, "errors": 1}

@@ -118,6 +118,13 @@ class KafkaSpanSink:
         self.topic = topic
         self.batch = batch
         self.stats = {"published": 0, "errors": 0}
+        # Async producers report delivery on their returned future from
+        # an IO thread; counters need the lock either way.
+        self._stats_lock = threading.Lock()
+
+    def _count(self, key: str, n: int) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
 
     def apply(self, spans: Sequence[Span]) -> None:
         if self.batch:
@@ -131,12 +138,22 @@ class KafkaSpanSink:
 
     def _send(self, payload: bytes, n: int) -> None:
         try:
-            self.producer(self.topic, payload)
-            self.stats["published"] += n
+            result = self.producer(self.topic, payload)
         except Exception:
             # The reference sink swallows-and-counts producer errors
             # rather than failing the write pipeline.
-            self.stats["errors"] += n
+            self._count("errors", n)
+            return
+        # Async producers (kafka-python) surface broker errors on the
+        # returned future, not synchronously — hook its callbacks so a
+        # down broker counts as errors instead of phantom publishes.
+        errback = getattr(result, "add_errback", None)
+        callback = getattr(result, "add_callback", None)
+        if callable(errback) and callable(callback):
+            callback(lambda *_: self._count("published", n))
+            errback(lambda *_: self._count("errors", n))
+        else:
+            self._count("published", n)
 
     def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
         pass

@@ -25,6 +25,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="framed-thrift Scribe.Log TCP port (0 disables)")
     p.add_argument("--memory-store", action="store_true",
                    help="use the in-memory reference store instead of TPU")
+    p.add_argument("--shards", type=int, default=0,
+                   help="serve from an N-shard ShardedSpanStore over the "
+                        "device mesh (0 = single-device store); needs N "
+                        "visible devices — use --platform cpu with "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                        "to simulate")
     p.add_argument("--capacity", type=int, default=1 << 16,
                    help="span ring capacity (device store)")
     p.add_argument("--sample-rate", type=float, default=1.0)
@@ -52,8 +58,14 @@ def build_app(args):
     from zipkin_tpu.sampler.adaptive import AdaptiveConfig
     from zipkin_tpu.sampler.core import Sampler
 
+    if args.checkpoint and (args.memory_store or args.shards):
+        raise SystemExit(
+            "--checkpoint requires the single-device store "
+            "(checkpointing the in-memory/sharded stores is not "
+            "supported; drop --checkpoint or the store flag)"
+        )
     store = None
-    if args.checkpoint and not args.memory_store:
+    if args.checkpoint and not args.memory_store and not args.shards:
         import os
 
         from zipkin_tpu import checkpoint
@@ -65,6 +77,25 @@ def build_app(args):
             from zipkin_tpu.store.memory import InMemorySpanStore
 
             store = InMemorySpanStore()
+        elif args.shards:
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            from zipkin_tpu.parallel.shard import ShardedSpanStore
+            from zipkin_tpu.store.device import StoreConfig
+
+            devices = jax.devices()
+            if len(devices) < args.shards:
+                raise SystemExit(
+                    f"--shards {args.shards} but only {len(devices)} "
+                    f"devices visible (see --shards help)"
+                )
+            mesh = Mesh(np.array(devices[:args.shards]),
+                        axis_names=("shard",))
+            store = ShardedSpanStore(
+                mesh, StoreConfig(capacity=args.capacity)
+            )
         else:
             from zipkin_tpu.store.device import StoreConfig
             from zipkin_tpu.store.tpu import TpuSpanStore
@@ -123,7 +154,7 @@ def main(argv=None) -> None:
     signal.signal(signal.SIGINT, lambda *_: stop.set())
 
     def checkpoint_now():
-        if args.checkpoint and not args.memory_store:
+        if args.checkpoint and not args.memory_store and not args.shards:
             from zipkin_tpu import checkpoint
 
             checkpoint.save(store, args.checkpoint)
