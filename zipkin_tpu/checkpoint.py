@@ -59,20 +59,26 @@ def _dict_load(dictionary, values: list) -> None:
             dictionary.encode(item["s"])
 
 
-def save(store: TpuSpanStore, path: str) -> None:
-    """Snapshot a TpuSpanStore to ``path`` (a directory), atomically."""
+def save(store, path: str) -> None:
+    """Snapshot a TpuSpanStore OR a ShardedSpanStore to ``path`` (a
+    directory), atomically. Sharded stores save their stacked
+    [n_shards, ...] state; load() re-shards it over a mesh."""
+    n_shards = getattr(store, "n", None) if hasattr(store, "states") else None
     leaves = {}
-    # Hold the read lock while gathering: ingest donates the previous
-    # state's buffers, so an unguarded snapshot could read freed memory.
+    # Hold the read lock only for the gather: ingest donates the
+    # previous state's buffers, so an unguarded snapshot could read
+    # freed memory. One batched device_get of the whole pytree, not a
+    # transfer per field — writers block on _rw for its duration.
     with store._rw.read():
-        state = store.state
-        for name in dev.StoreState._FIELDS:
-            value = getattr(state, name)
-            if name == "counters":
-                for k, v in value.items():
-                    leaves[f"counters.{k}"] = np.asarray(v)
-            else:
-                leaves[name] = np.asarray(value)
+        state = store.states if n_shards else store.state
+        host_state = jax.device_get(state)
+    for name in dev.StoreState._FIELDS:
+        value = getattr(host_state, name)
+        if name == "counters":
+            for k, v in value.items():
+                leaves[f"counters.{k}"] = np.asarray(v)
+        else:
+            leaves[name] = np.asarray(value)
     with store._lock:
         # Pinned traces' eviction-exempt banks must survive restarts —
         # the TTL alone restoring while the spans vanish would break the
@@ -87,6 +93,7 @@ def save(store: TpuSpanStore, path: str) -> None:
     meta = {
         "revision": _REVISION,
         "config": store.config._asdict(),
+        "shards": n_shards,
         "ttls": ttls_snapshot,
         "name_lc": {str(k): v for k, v in store._name_lc.items()},
         "dicts": {
@@ -124,9 +131,14 @@ def save(store: TpuSpanStore, path: str) -> None:
         raise
 
 
-def load(path: str) -> TpuSpanStore:
-    """Restore a TpuSpanStore from a snapshot directory (falling back to
-    the ``.old`` snapshot if a save crashed mid-swap)."""
+def load(path: str, mesh=None):
+    """Restore a store from a snapshot directory (falling back to the
+    ``.old`` snapshot if a save crashed mid-swap).
+
+    Single-device snapshots restore a TpuSpanStore. Sharded snapshots
+    (saved from a ShardedSpanStore) restore a ShardedSpanStore over
+    ``mesh`` — or a mesh built from the first n visible devices when
+    not given; the shard count must match the snapshot's."""
     if not os.path.isdir(path) and os.path.isdir(path + ".old"):
         path = path + ".old"
     with open(os.path.join(path, _META_FILE)) as f:
@@ -155,7 +167,34 @@ def load(path: str) -> TpuSpanStore:
     _dict_load(ann, d["annotations"])
     dicts.annotations = ann
 
-    store = TpuSpanStore(config, codec=SpanCodec(dicts))
+    n_shards = meta.get("shards")
+    if n_shards:
+        from jax.sharding import Mesh
+
+        from zipkin_tpu.parallel.shard import ShardedSpanStore
+
+        if mesh is None:
+            devices = jax.devices()
+            if len(devices) < n_shards:
+                raise ValueError(
+                    f"snapshot has {n_shards} shards but only "
+                    f"{len(devices)} devices are visible"
+                )
+            mesh = Mesh(np.array(devices[:n_shards]),
+                        axis_names=("shard",))
+        if "shard" not in mesh.shape:
+            raise ValueError(
+                f"mesh must have a 'shard' axis (ShardedSpanStore's "
+                f"axis); got axes {tuple(mesh.shape)}"
+            )
+        if mesh.shape["shard"] != n_shards:
+            raise ValueError(
+                f"snapshot has {n_shards} shards; mesh has "
+                f"{mesh.shape['shard']}"
+            )
+        store = ShardedSpanStore(mesh, config, codec=SpanCodec(dicts))
+    else:
+        store = TpuSpanStore(config, codec=SpanCodec(dicts))
     store.ttls = {int(k): v for k, v in meta["ttls"].items()}
     store._name_lc = {int(k): v for k, v in meta["name_lc"].items()}
     pins_path = os.path.join(path, _PINS_FILE)
@@ -193,6 +232,28 @@ def load(path: str) -> TpuSpanStore:
             upd["dep_overflow_ts"] = jax.numpy.asarray(
                 np.array([dev.I64_MIN, dev.I64_MAX], np.int64)
             )
+    if n_shards:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P("shard"))
+
+        def place(x):
+            return jax.device_put(jax.numpy.asarray(x), sharding)
+
+        upd = {
+            k: ({ck: place(cv) for ck, cv in v.items()}
+                if k == "counters" else place(v))
+            for k, v in upd.items()
+        }
+        with store._rw.write():
+            store.inner.states = store.inner.states.replace(**upd)
+        wps = np.asarray(jax.device_get(store.inner.states.write_pos))
+        gids = np.asarray(
+            jax.device_get(store.inner.states.dep_archived_gid)
+        )
+        store.inner._wp_upper = int(wps.max())
+        store.inner._archived_lower = int(gids.min())
+        return store
     with store._rw.write():
         store.state = store.state.replace(**upd)
     # Re-seed the host mirrors that drive the dependency-archive policy.

@@ -58,20 +58,27 @@ def build_app(args):
     from zipkin_tpu.sampler.adaptive import AdaptiveConfig
     from zipkin_tpu.sampler.core import Sampler
 
-    if args.checkpoint and (args.memory_store or args.shards):
+    if args.checkpoint and args.memory_store:
         raise SystemExit(
-            "--checkpoint requires the single-device store "
-            "(checkpointing the in-memory/sharded stores is not "
-            "supported; drop --checkpoint or the store flag)"
+            "--checkpoint requires a device store (the in-memory "
+            "reference store has no snapshot support)"
         )
     store = None
-    if args.checkpoint and not args.memory_store and not args.shards:
+    if args.checkpoint:
         import os
 
         from zipkin_tpu import checkpoint
 
         if os.path.isdir(args.checkpoint):
+            # A sharded snapshot restores a ShardedSpanStore (shard
+            # count from the snapshot; must match --shards if given).
             store = checkpoint.load(args.checkpoint)
+            n = getattr(store, "n", 0)
+            if args.shards and n != args.shards:
+                raise SystemExit(
+                    f"checkpoint has {n or 1} shard(s); --shards "
+                    f"{args.shards} does not match"
+                )
     if store is None:
         if args.memory_store:
             from zipkin_tpu.store.memory import InMemorySpanStore
@@ -154,7 +161,7 @@ def main(argv=None) -> None:
     signal.signal(signal.SIGINT, lambda *_: stop.set())
 
     def checkpoint_now():
-        if args.checkpoint and not args.memory_store and not args.shards:
+        if args.checkpoint:
             from zipkin_tpu import checkpoint
 
             checkpoint.save(store, args.checkpoint)
