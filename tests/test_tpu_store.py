@@ -348,3 +348,25 @@ def test_sharded_pinned_trace_survives_eviction():
     got = store.get_spans_by_trace_id(tid)
     assert sorted(s.id for s in got) == [1, 2]
     assert tid in store.traces_exist([tid])
+
+
+def test_hot_trace_candidate_escalation():
+    """One trace with more matching spans than the initial top-k window
+    (64): the escalating fetch must still surface the older cold trace
+    — and the result must match the in-memory oracle exactly."""
+    from zipkin_tpu.store.memory import InMemorySpanStore
+
+    ep = Endpoint(9, 80, "hotsvc")
+    hot = [Span(111, "h", 10_000 + i, None,
+                (Annotation(1000 + i, "sr", ep),), ())
+           for i in range(300)]
+    cold = [Span(222, "c", 99, None, (Annotation(5, "sr", ep),), ())]
+    tpu = small_store()
+    mem = InMemorySpanStore()
+    for st in (tpu, mem):
+        st.apply(hot + cold)
+    want = mem.get_trace_ids_by_name("hotsvc", None, 2**62, 2)
+    got = tpu.get_trace_ids_by_name("hotsvc", None, 2**62, 2)
+    assert [(i.trace_id, i.timestamp) for i in got] == \
+           [(i.trace_id, i.timestamp) for i in want]
+    assert [i.trace_id for i in got] == [111, 222]
