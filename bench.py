@@ -403,35 +403,39 @@ def main():
                     help="TPU stream length (default 1e8, smoke 2e5)")
     args = ap.parse_args()
 
-    if args.smoke:
-        spans = int(args.spans or 2e5)
-        store, ingest = bench_tpu_stream(
-            spans, capacity_log2=16, n_services=64, batch_traces=1024
+    # The SQL CPU reference first: it needs no device, so even a dead
+    # TPU backend still yields a valid one-line JSON result instead of
+    # an empty benchmark record.
+    sql = bench_sql_baseline(total_spans=2_000 if args.smoke else 10_000)
+    detail = {"config1_sql_cpu_reference": sql}
+    ingest = None
+    try:
+        if args.smoke:
+            store, ingest = bench_tpu_stream(
+                int(args.spans or 2e5), capacity_log2=16, n_services=64,
+                batch_traces=1024,
+            )
+        else:
+            store, ingest = bench_tpu_stream(int(args.spans or 1e8))
+        detail["config2_tpu_ingest"] = ingest
+        detail["tpu_queries"] = bench_tpu_queries(
+            store, reps=5 if args.smoke else 12
         )
-        queries = bench_tpu_queries(store, reps=5)
-        sql = bench_sql_baseline(total_spans=2_000)
-    else:
-        spans = int(args.spans or 1e8)
-        store, ingest = bench_tpu_stream(spans)
-        queries = bench_tpu_queries(store)
-        sql = bench_sql_baseline()
-
-    detail = {
-        "config1_sql_cpu_reference": sql,
-        "config2_tpu_ingest": ingest,
-        "tpu_queries": queries,
-    }
-    if args.compare_kernels:
-        del store  # free HBM before the second stream
-        detail["compare_kernels"] = bench_compare_kernels(
-            total_spans=int(2e5) if args.smoke else int(1e7)
-        )
+        if args.compare_kernels:
+            del store  # free HBM before the second stream
+            detail["compare_kernels"] = bench_compare_kernels(
+                total_spans=int(2e5) if args.smoke else int(1e7)
+            )
+    except Exception as e:  # noqa: BLE001 — emit a record either way
+        _log(f"TPU path failed: {e!r}")
+        detail["tpu_error"] = repr(e)
     print(json.dumps({
         "metric": "ingest_throughput",
-        "value": ingest["spans_per_s"],
+        "value": ingest["spans_per_s"] if ingest else 0.0,
         "unit": "spans/sec",
-        "vs_baseline": round(
-            ingest["spans_per_s"] / sql["ingest_spans_per_s"], 2
+        "vs_baseline": (
+            round(ingest["spans_per_s"] / sql["ingest_spans_per_s"], 2)
+            if ingest else 0.0
         ),
         "detail": detail,
     }))
