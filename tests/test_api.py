@@ -245,3 +245,32 @@ def test_negative_trace_id_roundtrip_through_hex_api():
     status, _ = api.handle("POST", "/api/pin/ffffffffffffff85/true", {})
     assert status == 200
     assert store.get_time_to_live(-123) > 1.0
+
+
+class TestTimelineComboRoutes:
+    def test_timeline_route(self, app):
+        status, body = app.handle("GET", "/api/timeline/1", {})
+        assert status == 200
+        assert body["traceId"] == "1"
+        assert body["annotations"]
+        assert body["annotations"] == sorted(
+            body["annotations"], key=lambda a: a["timestamp"])
+        assert {"serviceName", "spanName", "spanId"} <= set(
+            body["annotations"][0])
+
+    def test_combo_route(self, app):
+        status, body = app.handle("GET", "/api/combo/1", {})
+        assert status == 200
+        assert body["trace"] and body["summary"]["traceId"] == "1"
+        assert body["timeline"]["annotations"]
+        assert body["spanDepths"]
+
+    def test_missing_trace_404(self, app):
+        assert app.handle("GET", "/api/timeline/dead", {})[0] == 404
+        assert app.handle("GET", "/api/combo/dead", {})[0] == 404
+
+    def test_timeline_includes_binary_annotations(self, app):
+        status, body = app.handle("GET", "/api/timeline/1", {})
+        assert status == 200
+        assert body["binaryAnnotations"]
+        assert body["binaryAnnotations"][0]["key"]

@@ -22,6 +22,8 @@ from zipkin_tpu.ingest.receiver import (
     JsonReceiver,
     ResultCode,
     ScribeReceiver,
+    _hex_id,
+    binary_annotation_to_json,
     span_to_json,
 )
 from zipkin_tpu.query.request import QueryException
@@ -41,6 +43,39 @@ class RawResponse:
 
 def _trace_json(trace):
     return [span_to_json(s) for s in trace.spans]
+
+
+def _timeline_json(tl):
+    return {
+        "traceId": _hex_id(tl.trace_id),
+        "rootSpanId": _hex_id(tl.root_span_id),
+        "annotations": [
+            {
+                "timestamp": a.timestamp, "value": a.value,
+                "spanId": _hex_id(a.span_id),
+                "parentId": None if a.parent_id is None
+                else _hex_id(a.parent_id),
+                "serviceName": a.service_name, "spanName": a.span_name,
+            }
+            for a in tl.annotations
+        ],
+        "binaryAnnotations": [
+            binary_annotation_to_json(b) for b in tl.binary_annotations
+        ],
+    }
+
+
+def _summary_json(s):
+    return {
+        "traceId": _hex_id(s.trace_id),
+        "startTimestamp": s.start_timestamp,
+        "endTimestamp": s.end_timestamp,
+        "durationMicro": s.duration_micro,
+        "endpoints": [
+            {"ipv4": e.ipv4, "port": e.port, "serviceName": e.service_name}
+            for e in s.endpoints
+        ],
+    }
 
 
 def _moments_json(m):
@@ -175,6 +210,15 @@ class ApiServer:
         m = re.match(r"^/api/(?:trace|get)/(-?[0-9a-fA-F]+)$", path)
         if m:
             return self._trace(_parse_trace_id(m.group(1)), params)
+        # Thrift query-surface parity beyond the web routes:
+        # getTraceTimelinesByIds / getTraceCombosByIds
+        # (zipkinQuery.thrift:109-251).
+        m = re.match(r"^/api/timeline/(-?[0-9a-fA-F]+)$", path)
+        if m:
+            return self._timeline(_parse_trace_id(m.group(1)), params)
+        m = re.match(r"^/api/combo/(-?[0-9a-fA-F]+)$", path)
+        if m:
+            return self._combo(_parse_trace_id(m.group(1)), params)
         m = re.match(r"^/api/is_pinned/(-?[0-9a-fA-F]+)$", path)
         if m:
             return self._is_pinned(_parse_trace_id(m.group(1)))
@@ -206,28 +250,13 @@ class ApiServer:
         qr = extract_query(params)
         if qr is None:
             return 400, {"error": "serviceName is required"}
-        from zipkin_tpu.ingest.receiver import _hex_id
-
         resp = self.query.get_trace_ids(qr)
         summaries = self.query.get_trace_summaries_by_ids(resp.trace_ids)
         return 200, {
             "traceIds": [_hex_id(t) for t in resp.trace_ids],
             "startTs": resp.start_ts,
             "endTs": resp.end_ts,
-            "summaries": [
-                {
-                    "traceId": _hex_id(s.trace_id),
-                    "startTimestamp": s.start_timestamp,
-                    "endTimestamp": s.end_timestamp,
-                    "durationMicro": s.duration_micro,
-                    "endpoints": [
-                        {"ipv4": e.ipv4, "port": e.port,
-                         "serviceName": e.service_name}
-                        for e in s.endpoints
-                    ],
-                }
-                for s in summaries
-            ],
+            "summaries": [_summary_json(s) for s in summaries],
         }
 
     def _trace(self, trace_id: int, params):
@@ -236,6 +265,32 @@ class ApiServer:
         if not traces:
             raise KeyError(trace_id)
         return 200, _trace_json(traces[0])
+
+    def _timeline(self, trace_id: int, params):
+        adjust = params.get("adjust_clock_skew", "true") != "false"
+        tls = self.query.get_trace_timelines_by_ids([trace_id],
+                                                    adjust=adjust)
+        if not tls:
+            raise KeyError(trace_id)
+        return 200, _timeline_json(tls[0])
+
+    def _combo(self, trace_id: int, params):
+        adjust = params.get("adjust_clock_skew", "true") != "false"
+        combos = self.query.get_trace_combos_by_ids([trace_id],
+                                                    adjust=adjust)
+        if not combos or not combos[0].trace.spans:
+            raise KeyError(trace_id)
+        c = combos[0]
+        return 200, {
+            "trace": _trace_json(c.trace),
+            "summary": None if c.summary is None
+            else _summary_json(c.summary),
+            "timeline": None if c.timeline is None
+            else _timeline_json(c.timeline),
+            "spanDepths": None if c.span_depths is None else {
+                _hex_id(k): v for k, v in c.span_depths.items()
+            },
+        }
 
     def _dependencies(self, path, params):
         """Optionally windowed: /api/dependencies/<startTs>/<endTs> or

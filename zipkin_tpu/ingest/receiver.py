@@ -198,26 +198,31 @@ def _hex_id(v: int) -> str:
     return f"{v & (2**64 - 1):x}"
 
 
+def endpoint_to_json(e: Optional[Endpoint]):
+    if e is None:
+        return None
+    return {"ipv4": e.ipv4, "port": e.port, "serviceName": e.service_name}
+
+
+def binary_annotation_to_json(b) -> dict:
+    value = b.value
+    if isinstance(value, (bytes, bytearray)):
+        if b.annotation_type == AnnotationType.BYTES:
+            import base64
+
+            value = base64.b64encode(bytes(value)).decode("ascii")
+        else:
+            value = bytes(value).decode("utf-8", "replace")
+    return {
+        "key": b.key, "value": value,
+        "type": b.annotation_type.name,
+        "endpoint": endpoint_to_json(b.host),
+    }
+
+
 def span_to_json(s: Span) -> dict:
-    def ep(e: Optional[Endpoint]):
-        if e is None:
-            return None
-        return {"ipv4": e.ipv4, "port": e.port, "serviceName": e.service_name}
-
-    banns = []
-    for b in s.binary_annotations:
-        value = b.value
-        if isinstance(value, (bytes, bytearray)):
-            if b.annotation_type == AnnotationType.BYTES:
-                import base64
-
-                value = base64.b64encode(bytes(value)).decode("ascii")
-            else:
-                value = bytes(value).decode("utf-8", "replace")
-        banns.append({
-            "key": b.key, "value": value,
-            "type": b.annotation_type.name, "endpoint": ep(b.host),
-        })
+    ep = endpoint_to_json
+    banns = [binary_annotation_to_json(b) for b in s.binary_annotations]
     # Ids serialize as unsigned hex STRINGS (upstream zipkin JSON
     # convention, and span_from_json's string interpretation): a JSON
     # number round-trips through JS float64, which silently rounds ids

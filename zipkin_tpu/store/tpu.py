@@ -685,7 +685,12 @@ class TpuSpanStore(SpanStore):
     def counters(self) -> Dict[str, float]:
         with self._rw.read():
             vals = jax.device_get(self.state.counters)
-        return {k: float(v) for k, v in vals.items()}
+        out = {k: float(v) for k, v in vals.items()}
+        # Host-side guards surface through the same hook (the API's
+        # /metrics reads counters() generically).
+        out["anns_truncated"] = float(self.anns_truncated)
+        out["banns_truncated"] = float(self.banns_truncated)
+        return out
 
     def stored_span_count(self) -> float:
         """The DEVICE spans_seen counter (one scalar D2H per control
