@@ -56,43 +56,50 @@ class TestCheckpoint:
         # Dictionary ids survived: the same service maps to the same id.
         assert restored.dicts.services.get("api") == store.dicts.services.get("api")
 
-    def test_legacy_snapshot_without_watermark(self, tmp_path):
-        """A revision-1 snapshot (no dep_archived_gid leaf) must load with
-        the watermark at write_pos — its dep_moments bank already holds
-        every resident link, so a zero watermark would double-count."""
+    def test_legacy_snapshot_migrates_live_links(self, tmp_path):
+        """A pre-revision-4 snapshot carried unarchived links only
+        implicitly: resident ring rows past the dep_archived_gid
+        watermark, joined on demand by the retired ring join. load()
+        must reconstruct exactly those links into the streaming-join
+        window (no loss, no double count)."""
+        import json
         import os
 
         store = TpuSpanStore(CFG)
-        store.apply([rpc(1, 1, None, 100, 200), rpc(1, 2, 1, 110, 150)])
-        # Archive everything so dep_moments is the complete bank, the
-        # shape a legacy snapshot carried.
-        from zipkin_tpu.store import device as dev
-
-        with store._rw.write():
-            store.state = dev.dep_archive_step(store.state, store.state.write_pos)
-        path = str(tmp_path / "ckpt")
-        checkpoint.save(store, path)
+        store.apply([rpc(1, 1, None, 100, 200), rpc(1, 2, 1, 110, 150),
+                     rpc(2, 7, None, 300, 400), rpc(2, 8, 7, 310, 330)])
         expected = [(l.parent, l.child, l.duration_moments.count)
                     for l in store.get_dependencies().links]
+        assert expected  # the fixture must actually produce links
 
-        # Rewrite state.npz without the watermark leaf and meta.json
-        # without the revision field (the revision-1 layout).
-        import json
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(store, path)
 
+        # Rewrite the snapshot into the revision-3 layout: links exist
+        # only in the ring + a zero watermark; the streaming-join leaves
+        # don't exist yet.
         state_file = os.path.join(path, "state.npz")
         data = dict(np.load(state_file))
-        del data["dep_archived_gid"]
+        for gone in ("span_tab", "pend_key", "pend_dur", "pend_tsf",
+                     "pend_tsl", "pend_pos", "dep_window",
+                     "dep_window_ts"):
+            del data[gone]
+        data["dep_moments"] = np.zeros_like(data["dep_moments"])
+        data["dep_banks"] = np.zeros_like(data["dep_banks"])
+        data["dep_archived_gid"] = np.int64(0)
         np.savez_compressed(state_file, **data)
         meta_file = os.path.join(path, "meta.json")
         with open(meta_file) as f:
             meta = json.load(f)
-        del meta["revision"]
+        meta["revision"] = 3
+        cfg = dict(meta["config"])
+        cfg.pop("span_tab_slots", None)
+        cfg.pop("pend_slots", None)
+        meta["config"] = cfg
         with open(meta_file, "w") as f:
             json.dump(meta, f)
 
         restored = checkpoint.load(path)
-        assert int(restored.state.dep_archived_gid) == \
-            int(restored.state.write_pos)
         got = [(l.parent, l.child, l.duration_moments.count)
                for l in restored.get_dependencies().links]
         assert got == expected
@@ -243,3 +250,77 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
     d1 = {(l.parent, l.child) for l in store.get_dependencies().links}
     d2 = {(l.parent, l.child) for l in restored.get_dependencies().links}
     assert d1 == d2
+
+
+def test_sharded_legacy_snapshot_migrates(tmp_path):
+    """Pre-revision-4 SHARDED snapshot: per-shard live-link migration,
+    the [n_shards] write_pos fallback slicing, and the shard_map span-
+    table rebuild must all restore links and cross-batch joins."""
+    import json
+    import os
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from zipkin_tpu import checkpoint
+    from zipkin_tpu.parallel.shard import ShardedSpanStore
+    from zipkin_tpu.store.device import StoreConfig
+    from zipkin_tpu.tracegen import generate_traces
+
+    n = min(4, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:n]), axis_names=("shard",))
+    cfg = StoreConfig(
+        capacity=256, ann_capacity=1024, bann_capacity=512,
+        max_services=16, max_span_names=32, max_annotation_values=64,
+        max_binary_keys=16, cms_width=256, hll_p=6, quantile_buckets=128,
+    )
+    store = ShardedSpanStore(mesh, cfg)
+    traces = generate_traces(n_traces=10, max_depth=3, n_services=6)
+    parents = [t[0] for t in traces]
+    children = [s for t in traces for s in t[1:]]
+    store.apply(parents + children)
+    expected = {(l.parent, l.child, l.duration_moments.count)
+                for l in store.get_dependencies().links}
+    assert expected
+
+    path = str(tmp_path / "sharded-legacy")
+    checkpoint.save(store, path)
+
+    # Rewrite into the revision-3 layout: links only implicit in the
+    # per-shard rings + zero watermarks; no streaming-join leaves.
+    state_file = os.path.join(path, "state.npz")
+    data = dict(np.load(state_file))
+    for gone in ("span_tab", "pend_key", "pend_dur", "pend_tsf",
+                 "pend_tsl", "pend_pos", "dep_window", "dep_window_ts"):
+        del data[gone]
+    data["dep_moments"] = np.zeros_like(data["dep_moments"])
+    data["dep_banks"] = np.zeros_like(data["dep_banks"])
+    data["dep_archived_gid"] = np.zeros(n, np.int64)
+    np.savez_compressed(state_file, **data)
+    meta_file = os.path.join(path, "meta.json")
+    with open(meta_file) as f:
+        meta = json.load(f)
+    meta["revision"] = 3
+    for k in ("span_tab_slots", "pend_slots"):
+        meta["config"].pop(k, None)
+    with open(meta_file, "w") as f:
+        json.dump(meta, f)
+
+    restored = checkpoint.load(path, mesh=mesh)
+    got = {(l.parent, l.child, l.duration_moments.count)
+           for l in restored.get_dependencies().links}
+    assert got == expected
+    # The rebuilt span table must resolve a child arriving post-restore
+    # whose parent only exists in the checkpointed ring.
+    late = [t[1] for t in generate_traces(n_traces=1, max_depth=2,
+                                          n_services=6) if len(t) > 1]
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+
+    parent = parents[0]
+    ep = Endpoint(9, 80, sorted(restored.get_all_service_names())[0])
+    child = Span(parent.trace_id, "late", 987654, parent.id,
+                 (Annotation(50, "sr", ep), Annotation(60, "ss", ep)), ())
+    restored.apply([child])
+    after = {(l.parent, l.child) for l in restored.get_dependencies().links}
+    assert len(after) >= len({(p, c) for p, c, _ in expected})

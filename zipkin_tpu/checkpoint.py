@@ -30,7 +30,7 @@ _STATE_FILE = "state.npz"
 _META_FILE = "meta.json"
 _PINS_FILE = "pins.pkl"
 # Bump when the StoreState schema changes in a way load() must adapt to.
-_REVISION = 3
+_REVISION = 4
 
 
 def _dict_dump(d) -> list:
@@ -214,14 +214,17 @@ def load(path: str, mesh=None):
         else:
             upd[key] = jax.numpy.asarray(data[key])
     upd["counters"] = counters
-    if meta.get("revision", 1) < 2 and "dep_archived_gid" not in upd:
-        # Revision-1 snapshot (pre-watermark): its dep_moments bank was
-        # the complete link state at save time, so treat it as fully
-        # archived — a zero watermark would re-join every resident child
-        # via live_dep_moments and double-count.
-        upd["dep_archived_gid"] = jax.numpy.asarray(
-            np.int64(data["write_pos"])
-        )
+    # Leaves the current schema no longer carries (e.g. the r2 watermark
+    # dep_archived_gid, retired with the streaming hash join) are
+    # dropped; leaves the snapshot predates (span_tab, pending ring,
+    # dep_window) keep their init_state defaults — the table rebuilds as
+    # new spans arrive, and any SAVED state's links were already folded
+    # into dep_moments/dep_banks by the pre-upgrade archive policy.
+    known = set(dev.StoreState._FIELDS)
+    legacy = meta.get("revision", 1) < 4
+    upd = {k: v for k, v in upd.items() if k in known}
+    if legacy:
+        _migrate_legacy_live_links(data, upd, config, n_shards)
     if "dep_banks" not in upd:
         # Pre-revision-3 snapshot (single archive bank, no time tags):
         # the saved dep_moments becomes the all-time tail. Its ts range
@@ -247,16 +250,133 @@ def load(path: str, mesh=None):
         }
         with store._rw.write():
             store.inner.states = store.inner.states.replace(**upd)
+            if legacy:
+                store.inner.states = _sharded_rebuild_tab(
+                    mesh, store.inner.states
+                )
         wps = np.asarray(jax.device_get(store.inner.states.write_pos))
-        gids = np.asarray(
-            jax.device_get(store.inner.states.dep_archived_gid)
-        )
         store.inner._wp_upper = int(wps.max())
-        store.inner._archived_lower = int(gids.min())
+        # Links resolve at ingest now; the mirror only paces time-bucket
+        # rotation, so resume with the cadence clock at "just rotated".
+        store.inner._archived_lower = store.inner._wp_upper
         return store
     with store._rw.write():
         store.state = store.state.replace(**upd)
-    # Re-seed the host mirrors that drive the dependency-archive policy.
+        if legacy:
+            # The pre-rev-4 schema had no span table: re-insert resident
+            # spans so post-restore children still find their parents.
+            store.state = dev.rebuild_span_tab(store.state)
+    # Re-seed the host mirrors that pace dependency bucket rotation.
     store._wp = int(store.state.write_pos)
-    store._archived = int(store.state.dep_archived_gid)
+    store._archived = store._wp
     return store
+
+
+def _sharded_rebuild_tab(mesh, states):
+    """Per-shard rebuild_span_tab for legacy sharded snapshots."""
+    from jax.sharding import PartitionSpec as P
+
+    def fn(state):
+        state = jax.tree.map(lambda x: x[0], state)
+        new_state = dev.rebuild_span_tab.__wrapped__(state)
+        return jax.tree.map(lambda x: x[None], new_state)
+
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(P("shard"),), out_specs=P("shard"),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,))(states)
+
+
+def _migrate_legacy_live_links(data, upd, config, n_shards) -> None:
+    """Pre-revision-4 snapshots carry links only in dep_moments/dep_banks
+    plus an eviction watermark (dep_archived_gid): links of UNARCHIVED
+    resident children existed only implicitly, computed on demand by the
+    retired ring join. Reconstruct exactly those links here (host numpy,
+    same segmented-Moments arithmetic) and seed the new streaming-join
+    window bank with them, so an upgrade loses nothing."""
+    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
+
+    S = config.max_services
+
+    def one(slice_of):
+        gid = slice_of("row_gid")
+        live = gid >= 0
+        flags = slice_of("flags")
+        has_parent = (flags & int(FLAG_HAS_PARENT)) != 0
+        archived = np.int64(slice_of("dep_archived_gid"))
+        tid = slice_of("trace_id")
+        sid = slice_of("span_id")
+        pid = slice_of("parent_id")
+        svc = slice_of("service_id")
+        dur = slice_of("duration")
+        tsf = slice_of("ts_first")
+        tsl = slice_of("ts_last")
+        probe = live & has_parent & (gid >= archived)
+        window = np.zeros((S * S, 5), np.float32)
+        wts = np.array([dev.I64_MAX, dev.I64_MIN], np.int64)
+        if not probe.any():
+            return window, wts
+        order = np.lexsort((sid[live], tid[live]))
+        b_tid, b_sid = tid[live][order], sid[live][order]
+        b_svc = svc[live][order]
+        q_tid, q_pid = tid[probe], pid[probe]
+        # Two-key search: positions where (tid, sid) == (q_tid, q_pid).
+        bk = np.rec.fromarrays([b_tid, b_sid])
+        qk = np.rec.fromarrays([q_tid, q_pid])
+        pos = np.searchsorted(bk, qk)
+        pos_c = np.clip(pos, 0, len(bk) - 1)
+        found = (len(bk) > 0) & (bk[pos_c] == qk)
+        psvc = np.where(found, b_svc[pos_c], -1)
+        csvc = svc[probe]
+        d = dur[probe]
+        ok = found & (psvc >= 0) & (csvc >= 0) & (psvc < S) \
+            & (csvc < S) & (d >= 0)
+        if not ok.any():
+            return window, wts
+        link = (psvc.astype(np.int64) * S + csvc)[ok]
+        dv = d[ok].astype(np.float64)
+        n = np.bincount(link, minlength=S * S).astype(np.float64)
+        sx = np.bincount(link, weights=dv, minlength=S * S)
+        mean = np.divide(sx, n, out=np.zeros_like(sx), where=n > 0)
+        c = dv - mean[link]
+        m2 = np.bincount(link, weights=c * c, minlength=S * S)
+        m3 = np.bincount(link, weights=c * c * c, minlength=S * S)
+        m4 = np.bincount(link, weights=c * c * c * c, minlength=S * S)
+        window = np.stack([n, mean, m2, m3, m4], axis=-1).astype(
+            np.float32
+        )
+        ptsf, ptsl = tsf[probe][ok], tsl[probe][ok]
+        lo = ptsf[ptsf >= 0]
+        hi = ptsl[ptsl >= 0]
+        if lo.size:
+            wts[0] = lo.min()
+        if hi.size:
+            wts[1] = hi.max()
+        return window, wts
+
+    def col(name):
+        if name in data.files:
+            return np.asarray(data[name])
+        if name == "dep_archived_gid":
+            # Revision-1 layout: no watermark leaf, but its dep_moments
+            # bank was the complete link state — treat the ring as fully
+            # archived or every resident link would double-count.
+            return np.asarray(data["write_pos"])
+        return np.int64(0)
+
+    if n_shards:
+        windows, tss = [], []
+        for sh in range(n_shards):
+            def slice_of(name, sh=sh):
+                v = col(name)
+                return v[sh] if getattr(v, "ndim", 0) > 0 else v
+            w, t = one(slice_of)
+            windows.append(w)
+            tss.append(t)
+        upd["dep_window"] = jax.numpy.asarray(np.stack(windows))
+        upd["dep_window_ts"] = jax.numpy.asarray(np.stack(tss))
+    else:
+        w, t = one(col)
+        upd["dep_window"] = jax.numpy.asarray(w)
+        upd["dep_window_ts"] = jax.numpy.asarray(t)

@@ -56,20 +56,39 @@ def _summarize(state: dev.StoreState, axis: str) -> Dict[str, jnp.ndarray]:
 
 
 def make_sharded_archive(mesh: Mesh, axis: str = "shard"):
-    """Per-shard dependency-link archive step (dev.dep_archive_auto) so
-    links survive ring eviction in the sharded deployment exactly like
-    the single-store path; the watermark policy runs in-graph."""
+    """Per-shard dependency bucket close (dev.dep_close_bucket): sweeps
+    the pending ring and rotates the window bank, per shard, so the
+    sharded deployment keeps the same time-windowed banks as the
+    single-store path. Writes route whole traces to one shard, so the
+    streaming join is shard-local."""
 
     def fn(state, incoming):
+        del incoming  # cadence is the caller's policy; kept for compat
         state = jax.tree.map(lambda x: x[0], state)
-        new_state = dev.dep_archive_auto(state, incoming)
+        new_state = dev.dep_close_bucket.__wrapped__(state)
         return jax.tree.map(lambda x: x[None], new_state)
 
     mapped = jax.shard_map(
         fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+def make_sharded_sweep(mesh: Mesh, axis: str = "shard"):
+    """Per-shard pending sweep (dev.dep_sweep) — run before dependency
+    reads so cross-batch late parents are linked on every shard."""
+
+    def fn(state):
+        state = jax.tree.map(lambda x: x[0], state)
+        new_state = dev.dep_sweep.__wrapped__(state)
+        return jax.tree.map(lambda x: x[None], new_state)
+
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
 
 
 def make_sharded_ingest(mesh: Mesh, axis: str = "shard"):
@@ -114,27 +133,42 @@ class ShardedStore:
         self.states = jax.device_put(_stack_states(config, self.n), sharding)
         self.step = make_sharded_ingest(mesh, axis)
         self.archive_step = make_sharded_archive(mesh, axis)
+        self.sweep_step = make_sharded_sweep(mesh, axis)
         self.last_summary = None
         # Host upper bound of any shard's write_pos / lower bound of any
-        # shard's archive watermark — gates the archive trigger without
-        # device syncs (mirrors TpuSpanStore._maybe_archive).
+        # shard's last bucket close — paces rotation without device
+        # syncs (mirrors TpuSpanStore._maybe_archive).
         self._wp_upper = 0
         self._archived_lower = 0
+        self._batches_since_sweep = 0
+
+    # Same cadence as TpuSpanStore.SWEEP_EVERY: bounds how long a
+    # cross-batch child waits for its link in per-ingest summaries.
+    SWEEP_EVERY = 64
 
     def ingest(self, device_batches) -> Dict[str, np.ndarray]:
         """device_batches: pytree stacked [n_shards, ...]."""
         incoming = int(np.max(np.asarray(device_batches.n_spans)))
         self._maybe_archive(incoming)
+        self._batches_since_sweep += 1
+        if self._batches_since_sweep >= self.SWEEP_EVERY:
+            self.sweep()
         self.states, summary = self.step(self.states, device_batches)
         self._wp_upper += incoming
         self.last_summary = summary
         return summary
+
+    def sweep(self) -> None:
+        """Resolve pending (late-parent) children on every shard."""
+        self.states = self.sweep_step(self.states)
+        self._batches_since_sweep = 0
 
     def _maybe_archive(self, incoming: int) -> None:
         cap = self.config.capacity
         if self._wp_upper + incoming - self._archived_lower <= cap:
             return
         self.states = self.archive_step(self.states, jnp.int64(incoming))
+        self._batches_since_sweep = 0
         self._archived_lower = min(
             self._wp_upper,
             max(self._wp_upper + incoming - cap, self._wp_upper - cap // 2),
@@ -644,6 +678,14 @@ class ShardedSpanStore:
     def get_dependencies(self, start_ts=None, end_ts=None):
         from zipkin_tpu.aggregate.job import dependencies_from_bank
 
+        # Sweep first — but only when something was written since the
+        # last sweep, so read-only dependency polling stays a pure read
+        # (same contract as TpuSpanStore.get_dependencies).
+        if self.inner._batches_since_sweep:
+            with self._lock:
+                if self.inner._batches_since_sweep:
+                    with self._rw.write():
+                        self.inner.sweep()
         with self._rw.read():
             if start_ts is None and end_ts is None:
                 summary = self._summary_kernel()(self.states)

@@ -61,18 +61,47 @@ class StoreConfig(NamedTuple):
     # silently clip long durations into the top bucket.
     quantile_buckets: int = 2048
     quantile_alpha: float = 0.01
-    # Ring of time-tagged dependency-link archive banks: each archive
-    # pass lands in its own [S*S, 5] bank stamped with the joined
-    # children's ts range, so get_dependencies(start, end) can answer a
-    # window (Aggregates.getDependencies(startDate, endDate),
+    # Ring of time-tagged dependency-link banks: closing a time bucket
+    # (dep_close_bucket) rotates the accumulating window bank into its
+    # own [S*S, 5] slot stamped with the resolved children's ts range,
+    # so get_dependencies(start, end) can answer a window
+    # (Aggregates.getDependencies(startDate, endDate),
     # Aggregates.scala:26-31). Banks older than the ring merge into a
     # tail bank (all-time totals never regress).
     dep_buckets: int = 16
+    # Streaming-join state sizes (0 = derived from capacity). The span
+    # hash table resolves child → parent service at INGEST time (the
+    # device replacement for the Scalding parent×child shuffle join,
+    # ZipkinAggregateJob.scala:26-33); the pending ring holds children
+    # whose parent hasn't arrived yet, re-probed by dep_sweep.
+    span_tab_slots: int = 0  # open-addressing slots; default 2*capacity
+    pend_slots: int = 0  # pending-children ring; default capacity//4
     # Route ingest scatter-adds through the VMEM-resident pallas
     # histogram kernels (ops/pallas_kernels.py) instead of XLA scatter.
     # Benchmarked on the real chip by bench.py --compare-kernels; arrays
     # whose size is not a multiple of 128 lanes fall back to XLA.
     use_pallas: bool = False
+
+    @property
+    def tab_slots(self) -> int:
+        # Power of two: _tab_slots masks with n-1 and relies on an odd
+        # double-hash step being coprime to the table size.
+        return _next_pow2_int(self.span_tab_slots or 2 * self.capacity)
+
+    @property
+    def pending_slots(self) -> int:
+        # Never smaller than a max-size ingest chunk: one launch's
+        # unresolved children must fit without self-collision
+        # (TpuSpanStore.write_batch validates this).
+        return _next_pow2_int(self.pend_slots or max(1 << 16,
+                                                     self.capacity // 4))
+
+
+def _next_pow2_int(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
 
 
 def _ring(n, dtype, fill=0):
@@ -123,25 +152,34 @@ class StoreState:
     bann_write_pos: jnp.ndarray
 
     # -- streaming aggregate state (never evicted) ----------------------
-    # Dependency links use an eviction-watermark archive: each
-    # dep_archive_step folds links whose CHILD row gid crosses the
-    # watermark into a time-tagged bank (joined against the full
-    # resident ring, so parent/child halves arriving in different
-    # batches still link — ADVICE r1: a within-batch-only join
-    # systematically undercounts vs ZipkinAggregateJob). The K most
-    # recent archive passes each keep their own bank in ``dep_banks``
-    # stamped with the children's ts range in ``dep_bank_ts`` (the
-    # hourly-Dependencies-rows role, Dependencies.scala:59-67); on slot
-    # reuse the displaced bank merges into the all-time tail
-    # ``dep_moments``. Links of unarchived children are computed on
-    # demand by live_dep_moments; all parts are disjoint, so
-    # total = combine(tail, banks, live).
+    # Dependency links resolve at INGEST time through a streaming hash
+    # join: every span is inserted into ``span_tab`` (open addressing,
+    # key = mix48(trace_id, span_id), payload = service); every child
+    # batch row probes the table for its parent and, when found, its
+    # duration folds into the accumulating window bank ``dep_window``
+    # via the exact segmented-Moments reduction. Children whose parent
+    # hasn't arrived yet wait in the pending ring and are re-probed by
+    # ``dep_sweep``. This replaces the r2 eviction-watermark ring join,
+    # whose O(ring) sort cost every read and archive pass paid —
+    # measured 8.8s per get_dependencies at a 2^22 ring (NOTES_r03.md).
+    # ``dep_close_bucket`` rotates the window into a time-tagged slot of
+    # ``dep_banks`` (the hourly-Dependencies-rows role,
+    # Dependencies.scala:59-67); displaced slots merge into the all-time
+    # tail ``dep_moments``. All parts are disjoint:
+    # total = combine(tail, banks, window).
     dep_moments: jnp.ndarray  # [S*S, 5] f32 — tail (pre-ring) link moments
-    dep_banks: jnp.ndarray  # [K, S*S, 5] f32 — time-tagged archive ring
+    dep_banks: jnp.ndarray  # [K, S*S, 5] f32 — time-tagged bucket ring
     dep_bank_ts: jnp.ndarray  # [K, 2] i64 — (min first_ts, max last_ts)
     dep_overflow_ts: jnp.ndarray  # [2] i64 — ts range of the tail bank
-    dep_bank_seq: jnp.ndarray  # scalar i64 — next archive slot
-    dep_archived_gid: jnp.ndarray  # scalar i64 — archive watermark
+    dep_bank_seq: jnp.ndarray  # scalar i64 — next bucket slot
+    dep_window: jnp.ndarray  # [S*S, 5] f32 — accumulating current bucket
+    dep_window_ts: jnp.ndarray  # [2] i64 — ts range folded into window
+    span_tab: jnp.ndarray  # [H] i64 — (mix48 << 16)|(svc+1 << 1)|1; 0 empty
+    pend_key: jnp.ndarray  # [Q] i64 — (mix48(tid,parent) << 16)|(csvc+1<<1)|1
+    pend_dur: jnp.ndarray  # [Q] i64 — pending child duration
+    pend_tsf: jnp.ndarray  # [Q] i64 — pending child first_ts
+    pend_tsl: jnp.ndarray  # [Q] i64 — pending child last_ts
+    pend_pos: jnp.ndarray  # scalar i64 — pending ring cursor
     svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
     svc_span_counts: jnp.ndarray  # [S] f32
     ann_svc_counts: jnp.ndarray  # [S] f32 — services seen on any annotation
@@ -163,8 +201,9 @@ class StoreState:
         "bann_gid", "bann_key_id", "bann_value_id", "bann_type",
         "bann_service_id", "bann_endpoint_id", "bann_write_pos",
         "dep_moments", "dep_banks", "dep_bank_ts", "dep_overflow_ts",
-        "dep_bank_seq", "dep_archived_gid", "svc_hist", "svc_span_counts",
-        "ann_svc_counts",
+        "dep_bank_seq", "dep_window", "dep_window_ts", "span_tab",
+        "pend_key", "pend_dur", "pend_tsf", "pend_tsl", "pend_pos",
+        "svc_hist", "svc_span_counts", "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
         "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
     )
@@ -226,7 +265,14 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         ),
         dep_overflow_ts=jnp.array([I64_MAX, I64_MIN], jnp.int64),
         dep_bank_seq=jnp.int64(0),
-        dep_archived_gid=jnp.int64(0),
+        dep_window=jnp.zeros((S * S, M.N_FIELDS), jnp.float32),
+        dep_window_ts=jnp.array([I64_MAX, I64_MIN], jnp.int64),
+        span_tab=jnp.zeros(c.tab_slots, jnp.int64),
+        pend_key=jnp.zeros(c.pending_slots, jnp.int64),
+        pend_dur=jnp.zeros(c.pending_slots, jnp.int64),
+        pend_tsf=jnp.zeros(c.pending_slots, jnp.int64),
+        pend_tsl=jnp.zeros(c.pending_slots, jnp.int64),
+        pend_pos=jnp.int64(0),
         svc_hist=Q.init(
             shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
             dtype=jnp.int32,
@@ -419,54 +465,193 @@ def recompute_dep_moments(state: "StoreState"):
     )
 
 
-def _ring_children(state: "StoreState"):
-    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
+# -- streaming hash join ----------------------------------------------------
+#
+# The span hash table + pending ring resolve parent/child links at
+# ingest time. Per-op cost on this class of device grows with operand
+# ROWS (measured ~25-100ms per HLO op at 8M rows, NOTES_r03.md), so the
+# r2 design — an O(ring) sort-join per archive pass and per
+# get_dependencies — paid seconds per call; probing a hash table costs
+# a handful of ops on BATCH-sized arrays instead.
 
-    live = state.row_gid >= 0
-    has_parent = (state.flags & jnp.int32(int(FLAG_HAS_PARENT))) != 0
-    return live, live & has_parent
+_TAB_PROBES = 4
+_SVC_MASK = 0x7FFF  # 15-bit service payload (svc + 1; 0 = missing)
 
 
-@jax.jit
-def dep_archive_step(state: "StoreState", w_new) -> "StoreState":
-    """Fold links of children with archived_gid <= gid < ``w_new`` into
-    a fresh time-tagged archive bank and advance the watermark.
+def _mix48(a, b):
+    """48-bit mixed key of two i64 columns (uint64 result < 2^48)."""
+    from zipkin_tpu.ops.hashing import mix_keys64
 
-    Children join against the FULL resident ring, so parent and child
-    halves that arrived in different payloads (the normal case across
-    services) still produce their link — the streaming equivalent of
-    ZipkinAggregateJob.scala:26-38 run over a sliding window. Callers
-    (TpuSpanStore._maybe_archive) invoke this before unarchived rows can
-    be evicted, so every child is joined exactly once.
+    return mix_keys64([a, b]) >> jnp.uint64(16)
 
-    The bank lands in archive-ring slot ``dep_bank_seq % K`` stamped
-    with the window children's ts range; the displaced slot's content
-    merges into the all-time tail so totals never regress.
-    """
-    w_new = jnp.asarray(w_new, jnp.int64)
-    live, children = _ring_children(state)
-    probe = (
-        children
-        & (state.row_gid >= state.dep_archived_gid)
-        & (state.row_gid < w_new)
+
+def _tab_pack(key48, svc):
+    """(key48, service) → occupied table word (never 0)."""
+    s = (jnp.clip(svc, -1, _SVC_MASK - 2) + 1).astype(jnp.uint64)
+    return ((key48 << jnp.uint64(16)) | (s << jnp.uint64(1))
+            | jnp.uint64(1)).astype(jnp.int64)
+
+
+def _tab_slots(key48, n_slots: int):
+    """The probe sequence: double hashing over a power-of-two table."""
+    h0 = key48 & jnp.uint64(n_slots - 1)
+    step = ((key48 >> jnp.uint64(20)) << jnp.uint64(1)) | jnp.uint64(1)
+    return [
+        ((h0 + jnp.uint64(j) * step) & jnp.uint64(n_slots - 1)).astype(
+            jnp.int32
+        )
+        for j in range(_TAB_PROBES)
+    ]
+
+
+def _tab_lookup(tab, key48):
+    """(found, svc) per probe key — svc is -1 when absent/serviceless."""
+    found = jnp.zeros(key48.shape, bool)
+    svc = jnp.full(key48.shape, -1, jnp.int32)
+    for slot in _tab_slots(key48, tab.shape[0]):
+        cur = tab[slot].astype(jnp.uint64)
+        hit = ((cur & jnp.uint64(1)) == 1) & ((cur >> jnp.uint64(16)) == key48)
+        first = hit & ~found
+        svc = jnp.where(
+            first,
+            ((cur >> jnp.uint64(1)) & jnp.uint64(_SVC_MASK)).astype(
+                jnp.int32
+            ) - 1,
+            svc,
+        )
+        found |= hit
+    return found, svc
+
+
+def _tab_insert(tab, key48, svc, valid):
+    """Insert (key48 → svc) rows. Scatter-verify-retry per probe round:
+    two batch rows racing for one empty slot resolve deterministically
+    (the scatter's loser fails the read-back verify and retries its next
+    probe), so a key is only ever lost when all probes land on slots
+    occupied by foreign keys — then the last slot is stolen
+    (random-replacement eviction; the table outlives ring retention,
+    bounded like the reference's index TTL, CassieSpanStore.scala:48)."""
+    oob = tab.shape[0]
+    packed = _tab_pack(key48, svc)
+    placed = ~jnp.asarray(valid, bool)
+    slots = _tab_slots(key48, tab.shape[0])
+    for slot in slots:
+        cur = tab[slot].astype(jnp.uint64)
+        open_ = ((cur & jnp.uint64(1)) == 0) | (
+            (cur >> jnp.uint64(16)) == key48
+        )
+        attempt = ~placed & open_
+        tab = tab.at[jnp.where(attempt, slot, oob)].set(packed, mode="drop")
+        after = tab[slot].astype(jnp.uint64)
+        placed |= attempt & ((after >> jnp.uint64(16)) == key48)
+    return tab.at[jnp.where(placed, oob, slots[-1])].set(
+        packed, mode="drop"
     )
-    bank = dep_link_moments(
-        state.trace_id, state.span_id, state.parent_id, state.service_id,
-        state.duration, live, probe, state.config.max_services,
+
+
+def _window_fold(window, window_ts, durations, link_id, ok, tsf, tsl, S):
+    """Fold resolved links into the accumulating window bank (exact
+    segmented Moments — same Chan/Pébay arithmetic as the host monoid,
+    ZipkinAggregateJob.scala:36-46)."""
+    bank = M.segment_moments(
+        durations.astype(jnp.float32), link_id, S * S, valid=ok
     )
-    ts_f = jnp.where(probe & (state.ts_first >= 0), state.ts_first,
-                     I64_MAX).min()
-    ts_l = jnp.where(probe & (state.ts_last >= 0), state.ts_last,
-                     I64_MIN).max()
-    # Empty pass (no children in the window — e.g. an idle hourly
-    # timer): only advance the watermark. Rotating would displace one
-    # real time-tagged bank per idle tick into the untagged tail and
-    # erode the windowing.
-    rotate = probe.any()
+    new_window = M.combine(window, bank)
+    any_ok = ok.any()
+    ts_f = jnp.where(ok & (tsf >= 0), tsf, I64_MAX).min()
+    ts_l = jnp.where(ok & (tsl >= 0), tsl, I64_MIN).max()
+    new_ts = jnp.stack([
+        jnp.minimum(window_ts[0], ts_f), jnp.maximum(window_ts[1], ts_l)
+    ])
+    return new_window, jnp.where(any_ok, new_ts, window_ts)
+
+
+def _resolve_links(tab, trace_id, span_id, parent_id, svc, child_svc,
+                   duration, build_ok, probe_ok, S):
+    """Resolve each child's parent service: FIRST an exact within-batch
+    sort-join (batch-sized, so same-batch parent/child pairs — the
+    overwhelmingly common case — never depend on hash-table occupancy),
+    THEN a span-table probe for parents from earlier batches. Returns
+    (resolved, link_id, pending, ckey) — pending children found no
+    parent anywhere and wait in the pending ring."""
+    in_batch, psvc_b = join.lookup(
+        (trace_id, span_id), build_ok, svc,
+        (trace_id, parent_id), probe_ok,
+    )
+    ckey = _mix48(trace_id, parent_id)
+    in_tab, psvc_t = _tab_lookup(tab, ckey)
+    found = in_batch | in_tab
+    psvc = jnp.where(in_batch, psvc_b, psvc_t)
+    resolved = (
+        probe_ok & found & (psvc >= 0) & (child_svc >= 0)
+        & (child_svc < S) & (psvc < S) & (duration >= 0)
+    )
+    link_id = jnp.where(
+        resolved, psvc * jnp.int32(S) + child_svc, 0
+    )
+    # A found parent without a service can never produce a link: drop
+    # (matches the r2 join's link_ok gate), don't queue. Children whose
+    # own service can't address a bank cell never queue either.
+    pending = (probe_ok & ~found & (child_svc >= 0) & (child_svc < S)
+               & (duration >= 0))
+    return resolved, link_id, pending, ckey
+
+
+def _sweep_core(state: "StoreState"):
+    """Re-probe the pending ring; resolved children fold into the
+    window. Returns the updated (window, window_ts, pend_key)."""
+    S = state.config.max_services
+    u = state.pend_key.astype(jnp.uint64)
+    occupied = (u & jnp.uint64(1)) == 1
+    ckey = u >> jnp.uint64(16)
+    csvc = ((u >> jnp.uint64(1)) & jnp.uint64(_SVC_MASK)).astype(
+        jnp.int32
+    ) - 1
+    found, psvc = _tab_lookup(state.span_tab, ckey)
+    resolved = (occupied & found & (psvc >= 0) & (psvc < S)
+                & (csvc >= 0) & (csvc < S))
+    link_id = jnp.where(resolved, psvc * jnp.int32(S) + csvc, 0)
+    window, window_ts = _window_fold(
+        state.dep_window, state.dep_window_ts, state.pend_dur, link_id,
+        resolved, state.pend_tsf, state.pend_tsl, S,
+    )
+    # Children whose parent arrived without a service — or whose own
+    # service id can't address a bank cell — can never link: free their
+    # slots too.
+    drop = occupied & found & (
+        (psvc < 0) | (psvc >= S) | (csvc < 0) | (csvc >= S)
+    )
+    cleared = jnp.where(resolved | drop, jnp.int64(0), state.pend_key)
+    return window, window_ts, cleared
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def dep_sweep(state: "StoreState") -> "StoreState":
+    """Resolve pending children against the span table (the late-parent
+    half of the streaming join). Cheap relative to ring size — all ops
+    are pending-ring-sized. Called by the bucket close, before
+    dependency reads, and on the collector's timer."""
+    window, window_ts, cleared = _sweep_core(state)
+    return state.replace(
+        dep_window=window, dep_window_ts=window_ts, pend_key=cleared
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def dep_close_bucket(state: "StoreState") -> "StoreState":
+    """Sweep, then rotate the window bank into a time-tagged slot of
+    ``dep_banks`` — closing the current dependency time bucket (the
+    hourly-aggregation-timer role of the reference's AnormAggregator
+    schedule). The displaced slot merges into the all-time tail. An
+    empty window only sweeps: rotating would displace one real
+    time-tagged bank per idle tick and erode the windowing."""
+    window, window_ts, cleared = _sweep_core(state)
+    rotate = window[:, 0].sum() > 0
     K = state.config.dep_buckets
     slot = (state.dep_bank_seq % K).astype(jnp.int32)
     displaced = state.dep_banks[slot]
     displaced_ts = state.dep_bank_ts[slot]
+    empty_ts = jnp.array([I64_MAX, I64_MIN], jnp.int64)
     return state.replace(
         dep_moments=jnp.where(
             rotate, M.combine(state.dep_moments, displaced),
@@ -477,85 +662,67 @@ def dep_archive_step(state: "StoreState", w_new) -> "StoreState":
             jnp.maximum(state.dep_overflow_ts[1], displaced_ts[1]),
         ]), state.dep_overflow_ts),
         dep_banks=jnp.where(
-            rotate, state.dep_banks.at[slot].set(bank), state.dep_banks
+            rotate, state.dep_banks.at[slot].set(window), state.dep_banks
         ),
         dep_bank_ts=jnp.where(
-            rotate,
-            state.dep_bank_ts.at[slot].set(jnp.stack([ts_f, ts_l])),
+            rotate, state.dep_bank_ts.at[slot].set(window_ts),
             state.dep_bank_ts,
         ),
         dep_bank_seq=state.dep_bank_seq + rotate.astype(jnp.int64),
-        dep_archived_gid=jnp.maximum(state.dep_archived_gid, w_new),
+        dep_window=jnp.where(rotate, jnp.zeros_like(window), window),
+        dep_window_ts=jnp.where(rotate, empty_ts, window_ts),
+        pend_key=cleared,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def rebuild_span_tab(state: "StoreState") -> "StoreState":
+    """(Re)insert every live resident span into the hash table. Used
+    when restoring pre-revision-4 snapshots (whose schema had no table),
+    so children arriving after the restore still find checkpointed
+    parents — the case the retired resident-ring join covered."""
+    live = state.row_gid >= 0
+    key = _mix48(state.trace_id, state.span_id)
+    return state.replace(
+        span_tab=_tab_insert(state.span_tab, key, state.service_id, live)
+    )
+
+
+def dep_archive_step(state: "StoreState", w_new=None) -> "StoreState":
+    """Compatibility alias from the r2 watermark-archive API: closing a
+    bucket is the streaming join's analogue of an archive pass. The
+    watermark argument is vestigial (links no longer depend on ring
+    residency). NOTE: unlike the r2 original this DONATES ``state`` —
+    reassign the result, don't keep using the argument."""
+    del w_new
+    return dep_close_bucket(state)
+
+
+def dep_archive_auto(state: "StoreState", incoming=None) -> "StoreState":
+    """Compatibility alias (see dep_archive_step; donates ``state``)."""
+    del incoming
+    return dep_close_bucket(state)
+
+
+@jax.jit
+def _total_dep_impl(dep_moments, dep_banks, dep_window):
+    banks = M.reduce_moments(dep_banks, axis=0)
+    return M.combine(M.combine(dep_moments, banks), dep_window)
+
+
+def total_dep_moments(state: "StoreState"):
+    """Tail + time-tagged banks + accumulating window: the complete link
+    Moments bank. Callers wanting pending (late-parent) children
+    included run dep_sweep first — TpuSpanStore.get_dependencies does."""
+    return _total_dep_impl(
+        state.dep_moments, state.dep_banks, state.dep_window
     )
 
 
 @jax.jit
-def dep_archive_auto(state: "StoreState", incoming) -> "StoreState":
-    """dep_archive_step with the watermark policy computed in-graph:
-    archive everything an ``incoming``-span write could evict, keeping
-    at most the freshest half-capacity unarchived so late-arriving
-    parents can still link. Usable under shard_map (no host mirrors)."""
-    cap = state.config.capacity
-    wp = state.write_pos
-    w_new = jnp.maximum(wp + jnp.asarray(incoming, jnp.int64) - cap,
-                        wp - cap // 2)
-    w_new = jnp.minimum(jnp.maximum(w_new, state.dep_archived_gid), wp)
-    return dep_archive_step(state, w_new)
-
-
-@partial(jax.jit, static_argnums=(8,))
-def _live_dep_impl(trace_id, span_id, parent_id, service_id, duration,
-                   flags, row_gid, dep_archived_gid, n_services: int):
-    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
-
-    live = row_gid >= 0
-    has_parent = (flags & jnp.int32(int(FLAG_HAS_PARENT))) != 0
-    probe = live & has_parent & (row_gid >= dep_archived_gid)
-    return dep_link_moments(
-        trace_id, span_id, parent_id, service_id, duration, live, probe,
-        n_services,
-    )
-
-
-def _live_dep_args(state: "StoreState"):
-    return (state.trace_id, state.span_id, state.parent_id,
-            state.service_id, state.duration, state.flags, state.row_gid,
-            state.dep_archived_gid, state.config.max_services)
-
-
-def live_dep_moments(state: "StoreState"):
-    """Links whose child is live and not yet archived (gid >= watermark).
-    Disjoint from the archive bank; total links = combine of the two.
-    The jitted impl takes only the columns it reads (per-argument
-    dispatch overhead on tunneled devices)."""
-    return _live_dep_impl(*_live_dep_args(state))
-
-
-@partial(jax.jit, static_argnums=(10,))
-def _total_dep_impl(dep_moments, dep_banks, trace_id, span_id, parent_id,
-                    service_id, duration, flags, row_gid, dep_archived_gid,
-                    n_services: int):
-    banks = M.reduce_moments(dep_banks, axis=0)
-    live = _live_dep_impl(trace_id, span_id, parent_id, service_id,
-                          duration, flags, row_gid, dep_archived_gid,
-                          n_services)
-    return M.combine(M.combine(dep_moments, banks), live)
-
-
-def total_dep_moments(state: "StoreState"):
-    """Tail + time-tagged banks + live: the complete link Moments bank."""
-    return _total_dep_impl(
-        state.dep_moments, state.dep_banks, *_live_dep_args(state)
-    )
-
-
-@partial(jax.jit, static_argnums=(14,))
-def _dep_in_range_impl(dep_moments, dep_banks, dep_bank_ts, dep_overflow_ts,
-                       trace_id, span_id, parent_id, service_id, duration,
-                       flags, row_gid, dep_archived_gid, ts_first, ts_last,
-                       n_services: int, *, start_ts, end_ts):
-    from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
-
+def _dep_in_range_impl(dep_moments, dep_banks, dep_bank_ts,
+                       dep_overflow_ts, dep_window, dep_window_ts,
+                       start_ts, end_ts):
     start_ts = jnp.asarray(start_ts, jnp.int64)
     end_ts = jnp.asarray(end_ts, jnp.int64)
     bmin = dep_bank_ts[:, 0]
@@ -565,34 +732,21 @@ def _dep_in_range_impl(dep_moments, dep_banks, dep_bank_ts, dep_overflow_ts,
     total = M.reduce_moments(banks, axis=0)
     ov = (dep_overflow_ts[0] <= end_ts) & (dep_overflow_ts[1] >= start_ts)
     total = M.combine(total, jnp.where(ov, dep_moments, 0.0))
-    # Live (unarchived) children: include when their ts range overlaps.
-    live = row_gid >= 0
-    has_parent = (flags & jnp.int32(int(FLAG_HAS_PARENT))) != 0
-    probe = live & has_parent & (row_gid >= dep_archived_gid)
-    l_min = jnp.where(probe & (ts_first >= 0), ts_first, I64_MAX).min()
-    l_max = jnp.where(probe & (ts_last >= 0), ts_last, I64_MIN).max()
-    l_ok = (l_min <= end_ts) & (l_max >= start_ts)
-    live_bank = dep_link_moments(
-        trace_id, span_id, parent_id, service_id, duration, live, probe,
-        n_services,
-    )
-    return M.combine(total, jnp.where(l_ok, live_bank, 0.0))
+    w_ok = (dep_window_ts[0] <= end_ts) & (dep_window_ts[1] >= start_ts)
+    return M.combine(total, jnp.where(w_ok, dep_window, 0.0))
 
 
 def dep_moments_in_range(state: "StoreState", start_ts, end_ts):
-    """Link Moments restricted to archive banks (and the live window)
-    whose children's ts range overlaps [start_ts, end_ts] — the
-    device answer to Aggregates.getDependencies(startDate, endDate)
+    """Link Moments restricted to banks (and the open window) whose
+    children's ts range overlaps [start_ts, end_ts] — the device answer
+    to Aggregates.getDependencies(startDate, endDate)
     (Aggregates.scala:26-31). Bucket-granular: a bank overlapping the
     window contributes whole (the reference's hourly Dependencies rows
     are equally coarse, Dependencies.scala:59-67)."""
     return _dep_in_range_impl(
         state.dep_moments, state.dep_banks, state.dep_bank_ts,
-        state.dep_overflow_ts, state.trace_id, state.span_id,
-        state.parent_id, state.service_id, state.duration, state.flags,
-        state.row_gid, state.dep_archived_gid, state.ts_first,
-        state.ts_last, state.config.max_services,
-        start_ts=start_ts, end_ts=end_ts,
+        state.dep_overflow_ts, state.dep_window, state.dep_window_ts,
+        start_ts, end_ts,
     )
 
 
@@ -653,9 +807,36 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         upd[col] = getattr(state, col).at[bb_widx].set(getattr(b, col), mode="drop")
     upd["bann_write_pos"] = state.bann_write_pos + b.n_banns.astype(jnp.int64)
 
-    # Dependency links are NOT joined here: the within-batch join missed
-    # parent/child halves split across payloads. See dep_archive_step /
-    # live_dep_moments — the join always runs against the resident ring.
+    # -- streaming dependency join -------------------------------------
+    # Insert this batch's spans into the hash table FIRST so same-batch
+    # parents resolve immediately, then probe each child for its parent
+    # (ZipkinAggregateJob.scala:26-38 as a streaming hash join; r2's
+    # O(ring) sort-join cost seconds per pass at scale, NOTES_r03.md).
+    skey = _mix48(b.trace_id, b.span_id)
+    tab = _tab_insert(state.span_tab, skey, b.service_id, mask)
+    upd["span_tab"] = tab
+    resolved, link_id, pending, ckey = _resolve_links(
+        tab, b.trace_id, b.span_id, b.parent_id, b.service_id,
+        b.service_id, b.duration, mask, mask & b.has_parent, S,
+    )
+    upd["dep_window"], upd["dep_window_ts"] = _window_fold(
+        state.dep_window, state.dep_window_ts, b.duration, link_id,
+        resolved, b.ts_first, b.ts_last, S,
+    )
+    # Children whose parent hasn't arrived yet wait in the pending ring
+    # (re-probed by dep_sweep); the ring overwrites oldest-first, the
+    # bounded-wait analogue of the reference's index TTL.
+    Qp = state.pend_key.shape[0]
+    rank = jnp.cumsum(pending.astype(jnp.int64)) - 1
+    pslot = ((state.pend_pos + rank) % Qp).astype(jnp.int32)
+    pidx = jnp.where(pending, pslot, Qp)
+    upd["pend_key"] = state.pend_key.at[pidx].set(
+        _tab_pack(ckey, b.service_id), mode="drop"
+    )
+    upd["pend_dur"] = state.pend_dur.at[pidx].set(b.duration, mode="drop")
+    upd["pend_tsf"] = state.pend_tsf.at[pidx].set(b.ts_first, mode="drop")
+    upd["pend_tsl"] = state.pend_tsl.at[pidx].set(b.ts_last, mode="drop")
+    upd["pend_pos"] = state.pend_pos + pending.sum(dtype=jnp.int64)
 
     # -- per-service latency histogram ---------------------------------
     hist = svc_histogram(state)

@@ -139,10 +139,16 @@ class TpuSpanStore(SpanStore):
         # lock and hold it across their kernels + host gathers, while
         # the donating step runs under the write lock (ADVICE r1 high).
         self._rw = RWLock()
-        # Host mirrors of write_pos / dep_archived_gid, driving the
-        # dependency-archive policy without a device sync per batch.
+        # Host mirrors of write_pos / last-bucket-close position, pacing
+        # the dependency bucket rotation without a device sync per batch.
         self._wp = 0
         self._archived = 0
+        # Pending-sweep pacing: sweep every SWEEP_EVERY batches on the
+        # write path (bounds how long a cross-batch child waits for its
+        # link) and lazily before dependency reads — but only when
+        # something was written since the last sweep, so read-only
+        # dependency polling stays a pure read.
+        self._batches_since_sweep = 0
         # Keyed by to_signed64(trace_id) — ids >= 2^63 arrive unsigned
         # on some write paths and signed on others.
         self.ttls: Dict[int, float] = {}
@@ -197,7 +203,7 @@ class TpuSpanStore(SpanStore):
                     self._write_device(cb, clc, cix)
 
     def _chunk_by_trace(self, spans: Sequence[Span]):
-        chunk_size = min(self.MAX_CHUNK, self.config.capacity // 2 or 1)
+        chunk_size = self._max_chunk_spans()
         by_trace: Dict[int, List[Span]] = {}
         for s in spans:
             by_trace.setdefault(s.trace_id, []).append(s)
@@ -214,6 +220,13 @@ class TpuSpanStore(SpanStore):
                 batch = batch[chunk_size:]
         if batch:
             yield batch
+
+    def _max_chunk_spans(self) -> int:
+        """One-launch span bound: the span ring (colliding-slot scatter
+        guard) AND the pending ring (a launch's unresolved children must
+        fit without self-collision) both cap it."""
+        c = self.config
+        return min(self.MAX_CHUNK, c.capacity // 2 or 1, c.pending_slots)
 
     def _prune_ttls(self) -> None:
         prune_ttls(self.ttls, self.MAX_TTL_ENTRIES)
@@ -275,7 +288,7 @@ class TpuSpanStore(SpanStore):
         capacities (a single launch must never scatter colliding slots —
         see write_batch). The common case (batch fits) costs nothing."""
         c = self.config
-        max_spans = min(self.MAX_CHUNK, c.capacity // 2 or 1)
+        max_spans = self._max_chunk_spans()
         if (batch.n_spans <= max_spans
                 and batch.n_annotations <= c.ann_capacity
                 and batch.n_binary <= c.bann_capacity):
@@ -371,14 +384,14 @@ class TpuSpanStore(SpanStore):
         must chunk; ``apply`` does.
         """
         c = self.config
-        if (batch.n_spans > c.capacity
+        if (batch.n_spans > min(c.capacity, c.pending_slots)
                 or batch.n_annotations > c.ann_capacity
                 or batch.n_binary > c.bann_capacity):
             raise ValueError(
                 f"batch ({batch.n_spans} spans / {batch.n_annotations} anns "
                 f"/ {batch.n_binary} banns) exceeds ring capacity "
-                f"({c.capacity}/{c.ann_capacity}/{c.bann_capacity}); "
-                "split into smaller batches"
+                f"({min(c.capacity, c.pending_slots)}/{c.ann_capacity}/"
+                f"{c.bann_capacity}); split into smaller batches"
             )
         self._write_device(batch, self._name_lc_ids(batch), indexable)
 
@@ -398,18 +411,33 @@ class TpuSpanStore(SpanStore):
         with self._rw.write():
             self.state = dev.ingest_step(self.state, db)
         self._wp += batch.n_spans
+        self._batches_since_sweep += 1
+        if self._batches_since_sweep >= self.SWEEP_EVERY:
+            self._sweep_pending()
+
+    # Write-path sweep cadence (batches). Each sweep is one small launch
+    # over the pending ring; 64 bounds a cross-batch child's link
+    # latency to ~64 ItemQueue batches without taxing every write.
+    SWEEP_EVERY = 64
+
+    def _sweep_pending(self) -> None:
+        """Resolve pending (late-parent) children now; see dev.dep_sweep."""
+        with self._rw.write():
+            self.state = dev.dep_sweep(self.state)
+        self._batches_since_sweep = 0
 
     def _maybe_archive(self, incoming: int) -> None:
-        """Archive dependency links of ring rows an upcoming write could
-        evict (see dev.dep_archive_step). The watermark policy runs
-        in-graph (dep_archive_auto); the host mirrors only gate the
-        trigger, amortizing the full-ring join to one pass per
-        half-capacity of ingested spans."""
+        """Close the current dependency time bucket on a span-volume
+        cadence (one bucket per half ring capacity — the
+        hourly-aggregation-timer role). Unlike the r2 watermark archive
+        this is pure windowing policy: links resolve at ingest through
+        the streaming hash join and never depend on ring residency."""
         cap = self.config.capacity
         if self._wp + incoming - self._archived <= cap:
             return
         with self._rw.write():
-            self.state = dev.dep_archive_auto(self.state, incoming)
+            self.state = dev.dep_close_bucket(self.state)
+        self._batches_since_sweep = 0
         self._archived = min(
             self._wp, max(self._wp + incoming - cap, self._wp - cap // 2)
         )
@@ -598,14 +626,18 @@ class TpuSpanStore(SpanStore):
 
     def get_dependencies(self, start_ts: Optional[int] = None,
                          end_ts: Optional[int] = None) -> Dependencies:
-        """DependencyLinks from the time-tagged archive banks + a
-        live-ring join — Aggregates.getDependencies(startDate, endDate)
+        """DependencyLinks from the time-tagged banks + the accumulating
+        window — Aggregates.getDependencies(startDate, endDate)
         (Aggregates.scala:26-31). Without a window, the all-time total;
         with one, only banks whose children overlap it (bucket-granular).
-        Cross-batch parent/child pairs link because the join always runs
-        against the resident ring (dev.dep_archive_step docstring)."""
+        A pending sweep runs first so children whose parent arrived in a
+        later batch are linked before the read."""
         from zipkin_tpu.aggregate.job import dependencies_from_bank
 
+        if self._batches_since_sweep:
+            with self._lock:
+                if self._batches_since_sweep:
+                    self._sweep_pending()
         with self._rw.read():
             st = self.state
             if start_ts is None and end_ts is None:
@@ -628,16 +660,15 @@ class TpuSpanStore(SpanStore):
         )
 
     def archive_now(self) -> None:
-        """Fold every unarchived child's links into a fresh time-tagged
-        archive bank immediately (closes the current dependency time
-        bucket — the hourly-aggregation-timer role of
-        zipkin-deployment-web's AnormAggregator schedule)."""
+        """Close the current dependency time bucket immediately: sweep
+        pending children, rotate the window into a time-tagged bank (the
+        hourly-aggregation-timer role of zipkin-deployment-web's
+        AnormAggregator schedule)."""
         with self._lock:
             with self._rw.write():
-                self.state = dev.dep_archive_step(
-                    self.state, self.state.write_pos
-                )
+                self.state = dev.dep_close_bucket(self.state)
             self._archived = self._wp
+            self._batches_since_sweep = 0
 
     def service_duration_quantiles(
         self, service: str, qs: Sequence[float]
