@@ -66,8 +66,13 @@ class TestCheckpoint:
         import os
 
         store = TpuSpanStore(CFG)
+        # Trace 3's child arrives WITHOUT its parent: under the legacy
+        # schema it sat in the ring awaiting the on-demand join; the
+        # migration must queue it in the pending ring so the parent
+        # arriving post-upgrade still links.
         store.apply([rpc(1, 1, None, 100, 200), rpc(1, 2, 1, 110, 150),
-                     rpc(2, 7, None, 300, 400), rpc(2, 8, 7, 310, 330)])
+                     rpc(2, 7, None, 300, 400), rpc(2, 8, 7, 310, 330),
+                     rpc(3, 21, 20, 500, 550)])
         expected = [(l.parent, l.child, l.duration_moments.count)
                     for l in store.get_dependencies().links]
         assert expected  # the fixture must actually produce links
@@ -103,6 +108,15 @@ class TestCheckpoint:
         got = [(l.parent, l.child, l.duration_moments.count)
                for l in restored.get_dependencies().links]
         assert got == expected
+        # The orphan child queued by the migration links once its
+        # parent arrives post-restore (dep_sweep resolves the pending
+        # entry against the newly inserted parent).
+        before = sum(l.duration_moments.count
+                     for l in restored.get_dependencies().links)
+        restored.apply([rpc(3, 20, None, 490, 560)])
+        after = sum(l.duration_moments.count
+                    for l in restored.get_dependencies().links)
+        assert after >= before + 1  # the orphan child linked
 
     def test_atomic_overwrite(self, tmp_path):
         store = TpuSpanStore(CFG)

@@ -1,0 +1,140 @@
+"""Index column families: the fast path must answer exactly what the
+scan kernels answer.
+
+Two regimes matter (store.base.index_first_topk):
+- complete buckets (never wrapped): the index IS the full entry set for
+  the key, results must equal the scan's bit for bit;
+- wrapped buckets: the store falls back to the scan, so results must
+  again equal a scan-only store.
+
+Tracegen spans are cross-host (cs/cr on the client endpoint, sr/ss and
+the custom annotation on the server endpoint — tracegen/gen.py:59-67),
+so these tests exercise the host-set (min, max) entry pairs that make
+annotation queries exact for two-host spans.
+"""
+
+import pytest
+
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.tracegen import generate_traces
+
+
+def _cfg(use_index: bool, **kw) -> StoreConfig:
+    base = dict(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=64, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=256, use_index=use_index,
+    )
+    base.update(kw)
+    return StoreConfig(**base)
+
+
+def _pair(spans, **kw):
+    fast, scan = TpuSpanStore(_cfg(True, **kw)), TpuSpanStore(_cfg(False))
+    for st in (fast, scan):
+        st.apply(spans)
+    return fast, scan
+
+
+def _ids(res):
+    return [(i.trace_id, i.timestamp) for i in res]
+
+
+SPANS = [s for t in generate_traces(n_traces=25, max_depth=4,
+                                    n_services=6) for s in t]
+END_TS = max(s.last_timestamp for s in SPANS if s.last_timestamp) + 1
+
+
+@pytest.mark.parametrize("limit", [3, 10])
+def test_index_matches_scan_by_service(limit):
+    fast, scan = _pair(SPANS)
+    for svc in sorted(scan.get_all_service_names()):
+        assert _ids(fast.get_trace_ids_by_name(svc, None, END_TS, limit)) \
+            == _ids(scan.get_trace_ids_by_name(svc, None, END_TS, limit)), svc
+
+
+def test_index_matches_scan_by_span_name():
+    fast, scan = _pair(SPANS)
+    for svc in sorted(scan.get_all_service_names()):
+        for name in sorted(scan.get_span_names(svc)):
+            assert _ids(
+                fast.get_trace_ids_by_name(svc, name, END_TS, 10)
+            ) == _ids(
+                scan.get_trace_ids_by_name(svc, name, END_TS, 10)
+            ), (svc, name)
+
+
+def test_index_matches_scan_by_annotation_cross_host():
+    """The custom annotation is hosted by the SERVER endpoint; querying
+    it under the CLIENT service still matches (per-slot semantics), so
+    the host-set entry pairs must cover both."""
+    fast, scan = _pair(SPANS)
+    for svc in sorted(scan.get_all_service_names()):
+        assert _ids(fast.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, END_TS, 10
+        )) == _ids(scan.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, END_TS, 10
+        )), svc
+
+
+def test_index_matches_scan_by_binary_value():
+    fast, scan = _pair(SPANS)
+    for svc in sorted(scan.get_all_service_names()):
+        for value in (b"/api/widgets", None):
+            assert _ids(fast.get_trace_ids_by_annotation(
+                svc, "http.uri", value, END_TS, 10
+            )) == _ids(scan.get_trace_ids_by_annotation(
+                svc, "http.uri", value, END_TS, 10
+            )), (svc, value)
+
+
+def test_end_ts_filter_through_index():
+    fast, scan = _pair(SPANS)
+    svc = sorted(scan.get_all_service_names())[0]
+    mid = (min(s.first_timestamp for s in SPANS if s.first_timestamp)
+           + END_TS) // 2
+    assert _ids(fast.get_trace_ids_by_name(svc, None, mid, 10)) == \
+        _ids(scan.get_trace_ids_by_name(svc, None, mid, 10))
+
+
+def test_wrapped_bucket_falls_back_to_scan():
+    """Force tiny bucket depths so every bucket wraps: results must
+    still equal the scan-only store (index_first_topk fallback)."""
+    fast, scan = _pair(
+        SPANS,
+        idx_service_depth=64, idx_name_buckets=256, idx_name_depth=64,
+        idx_ann_buckets=256, idx_ann_depth=64, idx_bann_buckets=256,
+        idx_bann_depth=32,
+    )
+    # With 25*~N spans over 6 services, 64-deep service buckets wrap.
+    for svc in sorted(scan.get_all_service_names()):
+        assert _ids(fast.get_trace_ids_by_name(svc, None, END_TS, 10)) \
+            == _ids(scan.get_trace_ids_by_name(svc, None, END_TS, 10))
+        assert _ids(fast.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, END_TS, 10
+        )) == _ids(scan.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, END_TS, 10
+        ))
+
+
+def test_eviction_through_index():
+    """Evicted spans must vanish from index results (gid round-trip
+    liveness), exactly as they vanish from the scan."""
+    fast, scan = _pair([], )
+    small_fast = TpuSpanStore(_cfg(True, capacity=128, ann_capacity=512,
+                                   bann_capacity=256))
+    small_scan = TpuSpanStore(_cfg(False, capacity=128, ann_capacity=512,
+                                   bann_capacity=256))
+    spans = [s for t in generate_traces(n_traces=60, max_depth=3,
+                                        n_services=4) for s in t]
+    for st in (small_fast, small_scan):
+        st.apply(spans)  # > 2x capacity: the ring wraps
+    end_ts = max(s.last_timestamp for s in spans if s.last_timestamp) + 1
+    for svc in sorted(small_scan.get_all_service_names()):
+        assert _ids(
+            small_fast.get_trace_ids_by_name(svc, None, end_ts, 10)
+        ) == _ids(
+            small_scan.get_trace_ids_by_name(svc, None, end_ts, 10)
+        ), svc

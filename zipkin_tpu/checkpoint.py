@@ -294,10 +294,16 @@ def _migrate_legacy_live_links(data, upd, config, n_shards) -> None:
     resident children existed only implicitly, computed on demand by the
     retired ring join. Reconstruct exactly those links here (host numpy,
     same segmented-Moments arithmetic) and seed the new streaming-join
-    window bank with them, so an upgrade loses nothing."""
+    window bank with them — and queue children whose parent was NOT
+    resident into the pending ring (packed with the bit-identical host
+    mixer, hashing.np_mix_keys64), so a parent arriving after the
+    upgrade still links. An upgrade loses nothing."""
     from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
+    from zipkin_tpu.ops.hashing import np_mix_keys64
+    from zipkin_tpu.store.device import _SVC_MASK
 
     S = config.max_services
+    Q = config.pending_slots
 
     def one(slice_of):
         gid = slice_of("row_gid")
@@ -315,8 +321,15 @@ def _migrate_legacy_live_links(data, upd, config, n_shards) -> None:
         probe = live & has_parent & (gid >= archived)
         window = np.zeros((S * S, 5), np.float32)
         wts = np.array([dev.I64_MAX, dev.I64_MIN], np.int64)
+        pend = {
+            "pend_key": np.zeros(Q, np.int64),
+            "pend_dur": np.zeros(Q, np.int64),
+            "pend_tsf": np.zeros(Q, np.int64),
+            "pend_tsl": np.zeros(Q, np.int64),
+            "pend_pos": np.int64(0),
+        }
         if not probe.any():
-            return window, wts
+            return window, wts, pend
         order = np.lexsort((sid[live], tid[live]))
         b_tid, b_sid = tid[live][order], sid[live][order]
         b_svc = svc[live][order]
@@ -332,8 +345,28 @@ def _migrate_legacy_live_links(data, upd, config, n_shards) -> None:
         d = dur[probe]
         ok = found & (psvc >= 0) & (csvc >= 0) & (psvc < S) \
             & (csvc < S) & (d >= 0)
+        # Children with no resident parent: queue them (newest Q) so a
+        # parent arriving after the upgrade still links via dep_sweep —
+        # the same gate the device ingest uses for its pending pushes.
+        pend_mask = ~found & (csvc >= 0) & (csvc < S) & (d >= 0)
+        if pend_mask.any():
+            sel = np.flatnonzero(pend_mask)[-Q:]
+            nq = sel.size
+            key48 = np_mix_keys64(
+                [q_tid[sel], q_pid[sel]]
+            ) >> np.uint64(16)
+            svc_part = (np.clip(csvc[sel], -1, _SVC_MASK - 2)
+                        .astype(np.uint64) + np.uint64(1))
+            packed = ((key48 << np.uint64(16))
+                      | (svc_part << np.uint64(1))
+                      | np.uint64(1)).view(np.int64)
+            pend["pend_key"][:nq] = packed
+            pend["pend_dur"][:nq] = d[sel]
+            pend["pend_tsf"][:nq] = tsf[probe][sel]
+            pend["pend_tsl"][:nq] = tsl[probe][sel]
+            pend["pend_pos"] = np.int64(nq)
         if not ok.any():
-            return window, wts
+            return window, wts, pend
         link = (psvc.astype(np.int64) * S + csvc)[ok]
         dv = d[ok].astype(np.float64)
         n = np.bincount(link, minlength=S * S).astype(np.float64)
@@ -353,7 +386,7 @@ def _migrate_legacy_live_links(data, upd, config, n_shards) -> None:
             wts[0] = lo.min()
         if hi.size:
             wts[1] = hi.max()
-        return window, wts
+        return window, wts, pend
 
     def col(name):
         if name in data.files:
@@ -367,16 +400,24 @@ def _migrate_legacy_live_links(data, upd, config, n_shards) -> None:
 
     if n_shards:
         windows, tss = [], []
+        pends = {k: [] for k in ("pend_key", "pend_dur", "pend_tsf",
+                                 "pend_tsl", "pend_pos")}
         for sh in range(n_shards):
             def slice_of(name, sh=sh):
                 v = col(name)
                 return v[sh] if getattr(v, "ndim", 0) > 0 else v
-            w, t = one(slice_of)
+            w, t, p = one(slice_of)
             windows.append(w)
             tss.append(t)
+            for k in pends:
+                pends[k].append(p[k])
         upd["dep_window"] = jax.numpy.asarray(np.stack(windows))
         upd["dep_window_ts"] = jax.numpy.asarray(np.stack(tss))
+        for k, vs in pends.items():
+            upd[k] = jax.numpy.asarray(np.stack(vs))
     else:
-        w, t = one(col)
+        w, t, p = one(col)
         upd["dep_window"] = jax.numpy.asarray(w)
         upd["dep_window_ts"] = jax.numpy.asarray(t)
+        for k, v in p.items():
+            upd[k] = jax.numpy.asarray(v)

@@ -381,6 +381,90 @@ class ShardedSpanStore:
 
         return self._kernel(("svc", limit), build)
 
+    def _iq_by_service(self, limit: int, named: bool):
+        """Index fast-path kernel: per-shard O(depth) bucket read +
+        completeness flag (see dev.iquery_trace_ids_by_service). The
+        named/unnamed branch is host state, so it keys the kernel
+        cache, not a traced conditional."""
+        c = self.config
+
+        def build():
+            def fn(state, svc, name_lc, end_ts):
+                st = self._unstack(state)
+                if named:
+                    mat, complete, wm = dev._iq_verify_impl(
+                        st.name_idx, st.name_idx_pos, st.name_idx_wm,
+                        st.row_gid, st.indexable, st.trace_id, st.ts_last,
+                        c.capacity, c.name_buckets, c.name_depth,
+                        min(limit, c.name_depth),
+                        (svc.astype(jnp.int32), name_lc.astype(jnp.int32)),
+                        end_ts,
+                    )
+                else:
+                    mat, complete, wm = dev._iq_service_impl(
+                        st.svc_idx, st.svc_idx_pos, st.svc_idx_wm,
+                        st.row_gid, st.indexable, st.trace_id,
+                        st.ts_last, c.capacity, c.svc_depth,
+                        min(limit, c.svc_depth), svc, end_ts,
+                    )
+                return mat[None], complete[None], wm[None]
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(self.axis), P(), P(), P()),
+                out_specs=(P(self.axis),) * 3, check_vma=False,
+            ))
+
+        return self._kernel(("isvc", limit, named), build)
+
+    def _iq_by_annotation(self, limit: int, mode: str):
+        """mode: 'ann' (user annotation value), 'bkey' (binary key
+        only), or 'bval' (binary key + 1-2 value forms)."""
+        c = self.config
+
+        def build():
+            def fn(state, svc, ann, bkey, bval, bval2, end_ts):
+                st = self._unstack(state)
+                svc32 = svc.astype(jnp.int32)
+                if mode == "ann":
+                    mat, complete, wm = dev._iq_verify_impl(
+                        st.ann_idx, st.ann_idx_pos, st.ann_idx_wm,
+                        st.row_gid, st.indexable, st.trace_id, st.ts_last,
+                        c.capacity, c.ann_buckets, c.ann_depth,
+                        min(limit, c.ann_depth),
+                        (svc32, ann.astype(jnp.int32)), end_ts,
+                    )
+                elif mode == "bkey":
+                    mat, complete, wm = dev._iq_verify_impl(
+                        st.bann_idx, st.bann_idx_pos, st.bann_idx_wm,
+                        st.row_gid, st.indexable, st.trace_id, st.ts_last,
+                        c.capacity, c.bann_buckets, c.bann_depth,
+                        min(limit, c.bann_depth),
+                        (svc32, bkey.astype(jnp.int32), jnp.int32(-1)),
+                        end_ts,
+                    )
+                else:
+                    mat, complete, wm = dev._iq_verify2_impl(
+                        st.bann_idx, st.bann_idx_pos, st.bann_idx_wm,
+                        st.row_gid, st.indexable, st.trace_id, st.ts_last,
+                        c.capacity, c.bann_buckets, c.bann_depth,
+                        min(limit, c.bann_depth),
+                        (svc32, bkey.astype(jnp.int32),
+                         bval.astype(jnp.int32)),
+                        (svc32, bkey.astype(jnp.int32),
+                         bval2.astype(jnp.int32)),
+                        end_ts,
+                    )
+                return mat[None], complete[None], wm[None]
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(self.axis),) + (P(),) * 6,
+                out_specs=(P(self.axis),) * 3, check_vma=False,
+            ))
+
+        return self._kernel(("iann", limit, mode), build)
+
     def _q_by_annotation(self, limit: int):
         def build():
             def fn(state, svc, ann, bkey, bval, bval2, end_ts):
@@ -495,6 +579,23 @@ class ShardedSpanStore:
                 ))
             return self._shard_candidates(mats, k)
 
+        def index_fetch(k):
+            with self._rw.read():
+                mats, complete, wm = jax.device_get(
+                    self._iq_by_service(k, name_lc >= 0)(
+                        self.states, jnp.int32(svc), jnp.int32(name_lc),
+                        jnp.int64(end_ts),
+                    )
+                )
+            cands, _ = self._shard_candidates(mats, k)
+            return cands, bool(np.all(complete)), int(np.max(wm))
+
+        from zipkin_tpu.store.base import index_first_topk
+
+        if self.config.use_index:
+            return index_first_topk(
+                limit, self.config.ann_capacity, index_fetch, fetch
+            )
         return topk_ids_with_escalation(
             limit, self.config.ann_capacity, fetch
         )
@@ -525,7 +626,38 @@ class ShardedSpanStore:
                 ))
             return self._shard_candidates(mats, k)
 
+        if ann_value >= 0:
+            mode = "ann"
+        elif bann_value < 0 and bann_value2 < 0:
+            mode = "bkey"
+        else:
+            mode = "bval"
+        bv1 = bann_value if bann_value >= 0 else bann_value2
+        bv2 = bann_value2 if bann_value2 >= 0 else bv1
+        # Mixed user-annotation + binary-key names OR across families:
+        # only the scan sees both sides.
+        mixed = ann_value >= 0 and bann_key >= 0
+
+        def index_fetch(k):
+            with self._rw.read():
+                mats, complete, wm = jax.device_get(
+                    self._iq_by_annotation(k, mode)(
+                        self.states, jnp.int32(svc), jnp.int32(ann_value),
+                        jnp.int32(bann_key), jnp.int32(bv1),
+                        jnp.int32(bv2), jnp.int64(end_ts),
+                    )
+                )
+            cands, _ = self._shard_candidates(mats, k)
+            return cands, bool(np.all(complete)), int(np.max(wm))
+
+        from zipkin_tpu.store.base import index_first_topk
+
         c = self.config
+        if c.use_index and not mixed:
+            return index_first_topk(
+                limit, c.ann_capacity + c.bann_capacity, index_fetch,
+                fetch,
+            )
         return topk_ids_with_escalation(
             limit, c.ann_capacity + c.bann_capacity, fetch
         )

@@ -226,6 +226,38 @@ def topk_ids_with_escalation(limit: int, k_max: int, fetch,
         k = min(k * 8, k_max)
 
 
+def index_first_topk(limit: int, k_max: int, index_fetch,
+                     scan_fetch) -> List["IndexedTraceId"]:
+    """Index fast path with scan fallback, the shared read policy of the
+    device stores. ``index_fetch(k)`` reads an O(depth) index bucket and
+    returns (candidates, complete, watermark):
+
+    - ``complete`` — the bucket never wrapped, so it holds every entry
+      ever written for the key: the result is exact, full stop.
+    - otherwise the bucket holds its newest entries, and ``watermark``
+      is the max ts ever displaced from it: the result is exact iff the
+      limit-th ranked candidate still sits at or above the watermark
+      (every span the index no longer holds ranks at or below it).
+
+    Anything else falls back to the O(ring) scan kernel's escalation.
+    Near-monotonic traffic (the normal case: spans arrive roughly in
+    timestamp order) keeps wrapped buckets trusted; shuffled arrival
+    degrades to the scan, never to a wrong answer."""
+    k = limit * 8
+    candidates, complete, watermark = index_fetch(k)
+    ids = dedup_rank_limit(candidates, limit)
+    if len(ids) >= limit:
+        # A complete bucket's top candidates are exact; a wrapped one's
+        # are exact iff nothing displaced could outrank the limit-th.
+        if complete or ids[-1].timestamp >= watermark:
+            return ids
+    elif complete and len(candidates) < k:
+        # Every entry the bucket has ever held was inside the top-k
+        # window: the underfull result is the true, full answer.
+        return ids
+    return topk_ids_with_escalation(limit, k_max, scan_fetch)
+
+
 def dedup_rank_limit(candidates, limit: int) -> List["IndexedTraceId"]:
     """One IndexedTraceId per trace id (max timestamp wins), sorted by
     timestamp descending, truncated to ``limit`` — the dedup-before-limit

@@ -76,6 +76,21 @@ class StoreConfig(NamedTuple):
     # whose parent hasn't arrived yet, re-probed by dep_sweep.
     span_tab_slots: int = 0  # open-addressing slots; default 2*capacity
     pend_slots: int = 0  # pending-children ring; default capacity//4
+    # Device index column families (the ServiceNameIndex /
+    # ServiceSpanNameIndex / AnnotationsIndex roles,
+    # cassandra-schema.txt:1-22): per-key FIFO bucket rings written by
+    # batch scatters at ingest, so index queries read O(bucket depth)
+    # rows instead of scanning the rings — on this device class every
+    # HLO op costs ~25-100ms at ring size (NOTES_r03.md), which made
+    # O(ring) index queries ~1s each. 0 = derived from capacity.
+    use_index: bool = True
+    idx_service_depth: int = 0
+    idx_name_buckets: int = 0
+    idx_name_depth: int = 0
+    idx_ann_buckets: int = 0
+    idx_ann_depth: int = 0
+    idx_bann_buckets: int = 0
+    idx_bann_depth: int = 0
     # Route ingest scatter-adds through the VMEM-resident pallas
     # histogram kernels (ops/pallas_kernels.py) instead of XLA scatter.
     # Benchmarked on the real chip by bench.py --compare-kernels; arrays
@@ -95,6 +110,39 @@ class StoreConfig(NamedTuple):
         # (TpuSpanStore.write_batch validates this).
         return _next_pow2_int(self.pend_slots or max(1 << 16,
                                                      self.capacity // 4))
+
+    def _derived(self, explicit: int, lo: int, hi: int) -> int:
+        return _next_pow2_int(
+            explicit or max(lo, min(hi, self.capacity // 8))
+        )
+
+    @property
+    def svc_depth(self) -> int:
+        return self._derived(self.idx_service_depth, 64, 4096)
+
+    @property
+    def name_buckets(self) -> int:
+        return self._derived(self.idx_name_buckets, 256, 8192)
+
+    @property
+    def name_depth(self) -> int:
+        return self._derived(self.idx_name_depth, 64, 512)
+
+    @property
+    def ann_buckets(self) -> int:
+        return self._derived(self.idx_ann_buckets, 256, 16384)
+
+    @property
+    def ann_depth(self) -> int:
+        return self._derived(self.idx_ann_depth, 64, 512)
+
+    @property
+    def bann_buckets(self) -> int:
+        return self._derived(self.idx_bann_buckets, 256, 8192)
+
+    @property
+    def bann_depth(self) -> int:
+        return self._derived(self.idx_bann_depth, 32, 256)
 
 
 def _next_pow2_int(n: int) -> int:
@@ -180,6 +228,26 @@ class StoreState:
     pend_tsf: jnp.ndarray  # [Q] i64 — pending child first_ts
     pend_tsl: jnp.ndarray  # [Q] i64 — pending child last_ts
     pend_pos: jnp.ndarray  # scalar i64 — pending ring cursor
+
+    # -- index column families -------------------------------------------
+    # Flat [B*K, 3] i64 entry arrays (gid, verify, ts) + [B] i32 cursors
+    # + [B] i64 overwrite watermarks. Bucket b's FIFO ring is rows
+    # [b*K, (b+1)*K); cursor <= K means the bucket never wrapped (it
+    # holds EVERY entry ever written for its key → an index read is
+    # complete); a wrapped bucket is still exact when the query's last
+    # candidate ranks >= the watermark (see _index_write).
+    svc_idx: jnp.ndarray
+    svc_idx_pos: jnp.ndarray
+    svc_idx_wm: jnp.ndarray
+    name_idx: jnp.ndarray
+    name_idx_pos: jnp.ndarray
+    name_idx_wm: jnp.ndarray
+    ann_idx: jnp.ndarray
+    ann_idx_pos: jnp.ndarray
+    ann_idx_wm: jnp.ndarray
+    bann_idx: jnp.ndarray
+    bann_idx_pos: jnp.ndarray
+    bann_idx_wm: jnp.ndarray
     svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
     svc_span_counts: jnp.ndarray  # [S] f32
     ann_svc_counts: jnp.ndarray  # [S] f32 — services seen on any annotation
@@ -203,6 +271,10 @@ class StoreState:
         "dep_moments", "dep_banks", "dep_bank_ts", "dep_overflow_ts",
         "dep_bank_seq", "dep_window", "dep_window_ts", "span_tab",
         "pend_key", "pend_dur", "pend_tsf", "pend_tsl", "pend_pos",
+        "svc_idx", "svc_idx_pos", "svc_idx_wm",
+        "name_idx", "name_idx_pos", "name_idx_wm",
+        "ann_idx", "ann_idx_pos", "ann_idx_wm",
+        "bann_idx", "bann_idx_pos", "bann_idx_wm",
         "svc_hist", "svc_span_counts", "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
         "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
@@ -273,6 +345,20 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         pend_tsf=jnp.zeros(c.pending_slots, jnp.int64),
         pend_tsl=jnp.zeros(c.pending_slots, jnp.int64),
         pend_pos=jnp.int64(0),
+        svc_idx=jnp.full((S * c.svc_depth, 3), -1, jnp.int64),
+        svc_idx_pos=jnp.zeros(S, jnp.int32),
+        svc_idx_wm=jnp.full(S, I64_MIN, jnp.int64),
+        name_idx=jnp.full((c.name_buckets * c.name_depth, 3), -1,
+                          jnp.int64),
+        name_idx_pos=jnp.zeros(c.name_buckets, jnp.int32),
+        name_idx_wm=jnp.full(c.name_buckets, I64_MIN, jnp.int64),
+        ann_idx=jnp.full((c.ann_buckets * c.ann_depth, 3), -1, jnp.int64),
+        ann_idx_pos=jnp.zeros(c.ann_buckets, jnp.int32),
+        ann_idx_wm=jnp.full(c.ann_buckets, I64_MIN, jnp.int64),
+        bann_idx=jnp.full((c.bann_buckets * c.bann_depth, 3), -1,
+                          jnp.int64),
+        bann_idx_pos=jnp.zeros(c.bann_buckets, jnp.int32),
+        bann_idx_wm=jnp.full(c.bann_buckets, I64_MIN, jnp.int64),
         svc_hist=Q.init(
             shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
             dtype=jnp.int32,
@@ -547,6 +633,100 @@ def _tab_insert(tab, key48, svc, valid):
     return tab.at[jnp.where(placed, oob, slots[-1])].set(
         packed, mode="drop"
     )
+
+
+# -- index column families ---------------------------------------------------
+#
+# Each family is a flat [B*K, 2] i64 array of (span gid, verify) entries
+# in per-bucket FIFO rings plus a [B] i32 cursor — the device rendition
+# of the reference's index column families (ServiceNameIndex /
+# ServiceSpanNameIndex / AnnotationsIndex, CassieSpanStore.scala:168-251,
+# cassandra-schema.txt). Written by batch-sized scatters inside
+# ingest_step; read by O(depth) bucket slices. Entry liveness is checked
+# against the span ring at query time (gid round-trip), so eviction
+# needs no index maintenance.
+
+
+def _fifo_ranks(bucket, valid):
+    """Arrival-order rank of each row within its bucket. One stable
+    single-key sort (bucket in the high bits, row index in the low bits)
+    + a cummax segment-start fill — deterministic, so two ingests of the
+    same batch produce bitwise-identical index state."""
+    n = bucket.shape[0]
+    assert n < (1 << 20), "index write exceeds rank key space"
+    key = jnp.where(valid, bucket.astype(jnp.int64), jnp.int64(1) << 42)
+    skey = (key << 20) | jnp.arange(n, dtype=jnp.int64)
+    order = jnp.argsort(skey)
+    sk = key[order]
+    first = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+    idxs = jnp.arange(n, dtype=jnp.int32)
+    start = jax.lax.cummax(jnp.where(first, idxs, jnp.int32(-1)))
+    return jnp.zeros(n, jnp.int32).at[order].set(idxs - start)
+
+
+def _index_write(entries, pos, wm, bucket, gid, verify, ts, valid,
+                 depth: int):
+    """Append (gid, verify, ts) rows to their buckets' FIFO rings.
+
+    ``wm`` is the per-bucket overwrite watermark: the max ts ever
+    displaced from the ring (by wraparound, or by in-batch overflow
+    where one launch writes more than ``depth`` rows to a bucket and
+    keeps the newest). Queries on a wrapped bucket are exact iff their
+    last returned candidate still ranks >= the watermark — every span
+    the index no longer holds ranks at or below it."""
+    n_b = pos.shape[0]
+    rank = _fifo_ranks(bucket, valid)
+    b_c = jnp.clip(bucket, 0, n_b - 1)
+    oob_b = jnp.where(valid, b_c, n_b)
+    cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
+        1, mode="drop")[:n_b]
+    keep = valid & (rank >= cnt[b_c] - depth)
+    slot = b_c * depth + ((pos[b_c] + rank) % depth)
+    idx = jnp.where(keep, slot, entries.shape[0])
+    old = entries[jnp.clip(idx, 0, entries.shape[0] - 1)]
+    old_ts = jnp.where(keep & (old[:, 0] >= 0), old[:, 2], I64_MIN)
+    dropped_ts = jnp.where(valid & ~keep, jnp.asarray(ts, jnp.int64),
+                           I64_MIN)
+    wm = wm.at[oob_b].max(jnp.maximum(old_ts, dropped_ts), mode="drop")
+    vals = jnp.stack(
+        [jnp.asarray(gid, jnp.int64), jnp.asarray(verify, jnp.int64),
+         jnp.asarray(ts, jnp.int64)],
+        axis=-1,
+    )
+    entries = entries.at[idx].set(vals, mode="drop")
+    pos = pos.at[oob_b].add(1, mode="drop")
+    return entries, pos, wm
+
+
+def _span_host_range(ann_svc, ann_span_idx, valid_a, n_spans: int):
+    """Per span: (min, max) service over its annotation hosts — the
+    span's host SET for spans with at most two distinct hosts (the
+    cs/cr-client + sr/ss-server shape of real traffic). Spans with more
+    distinct hosts index under min/max only (counted nowhere: the scan
+    fallback still finds them when a bucket is incomplete)."""
+    big = jnp.int32(1 << 30)
+    seg = jnp.where(valid_a, ann_span_idx, n_spans)
+    mn = jnp.full(n_spans + 1, big, jnp.int32).at[seg].min(
+        jnp.where(valid_a, ann_svc, big), mode="drop"
+    )[:n_spans]
+    mx = jnp.full(n_spans + 1, -1, jnp.int32).at[seg].max(
+        jnp.where(valid_a, ann_svc, -1), mode="drop"
+    )[:n_spans]
+    return mn, mx
+
+
+def _mixb(keys):
+    from zipkin_tpu.ops.hashing import mix_keys64
+
+    return mix_keys64([jnp.asarray(k, jnp.int64) for k in keys])
+
+
+def _bucket_of(mixed, n_buckets: int):
+    return (mixed & jnp.uint64(n_buckets - 1)).astype(jnp.int32)
+
+
+def _verify_of(mixed):
+    return mixed.astype(jnp.int64)
 
 
 def _window_fold(window, window_ts, durations, link_id, ok, tsf, tsl, S):
@@ -838,6 +1018,86 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     upd["pend_tsl"] = state.pend_tsl.at[pidx].set(b.ts_last, mode="drop")
     upd["pend_pos"] = state.pend_pos + pending.sum(dtype=jnp.int64)
 
+    # -- index column families -----------------------------------------
+    # (written before the counter block; the ann-derived columns below
+    # are shared with the presence/top-annotation updates further down)
+    if c.use_index:
+        a_host = b.ann_service_id
+        a_idx_ok = mask_a & (a_host >= 0) & (a_host < S)
+        gid_a = jnp.where(a_idx_ok, span_gid_of_ann, -1)
+        ts_a = b.ts_last[b.ann_span_idx]
+        # Service family: bucket = the annotation's own host service —
+        # exactly the rows the scan kernel matches for a service query.
+        upd["svc_idx"], upd["svc_idx_pos"], upd["svc_idx_wm"] = \
+            _index_write(
+                state.svc_idx, state.svc_idx_pos, state.svc_idx_wm,
+                jnp.clip(a_host, 0, S - 1), gid_a,
+                a_host.astype(jnp.int64), ts_a, a_idx_ok, c.svc_depth,
+            )
+        # (service, span name) family.
+        ann_name_lc_i = b.name_lc_id[b.ann_span_idx]
+        nm_ok = a_idx_ok & (ann_name_lc_i >= 0)
+        nm_mix = _mixb([a_host, ann_name_lc_i])
+        upd["name_idx"], upd["name_idx_pos"], upd["name_idx_wm"] = \
+            _index_write(
+                state.name_idx, state.name_idx_pos, state.name_idx_wm,
+                _bucket_of(nm_mix, c.name_buckets), gid_a,
+                _verify_of(nm_mix), ts_a, nm_ok, c.name_depth,
+            )
+        # (service, annotation value) family: a span's value can match a
+        # query under ANY of its hosts (per-slot semantics of the scan /
+        # the in-memory oracle), so entries are written under the span's
+        # host-set (min, max) pair. Core annotations are never queryable
+        # (SpanStore.scala:199) and are skipped.
+        hmin, hmax = _span_host_range(a_host, b.ann_span_idx, a_idx_ok, P)
+        h1 = hmin[b.ann_span_idx]
+        h2 = hmax[b.ann_span_idx]
+        v_ok = (
+            mask_a & (b.ann_value_id >= FIRST_USER_ANNOTATION_ID)
+            & (b.ann_value_id < jnp.int32(1 << 30))
+        )
+        av_host = jnp.concatenate([h1, h2])
+        av_val = jnp.concatenate([b.ann_value_id, b.ann_value_id])
+        av_gid = jnp.concatenate([span_gid_of_ann, span_gid_of_ann])
+        av_ok = jnp.concatenate([
+            v_ok & (h1 >= 0) & (h1 < S),
+            v_ok & (h2 >= 0) & (h2 < S) & (h2 != h1),
+        ])
+        av_mix = _mixb([av_host, av_val])
+        av_ts = jnp.concatenate([ts_a, ts_a])
+        upd["ann_idx"], upd["ann_idx_pos"], upd["ann_idx_wm"] = \
+            _index_write(
+                state.ann_idx, state.ann_idx_pos, state.ann_idx_wm,
+                _bucket_of(av_mix, c.ann_buckets),
+                jnp.where(av_ok, av_gid, -1),
+                _verify_of(av_mix), av_ts, av_ok, c.ann_depth,
+            )
+        # (service, binary key[, value]) family: two bucket keyings per
+        # host — with the value (valued queries) and with a -1 sentinel
+        # (key-only queries) — under the span's host-set pair.
+        bh1 = hmin[b.bann_span_idx]
+        bh2 = hmax[b.bann_span_idx]
+        bk_idx_ok = mask_b & (b.bann_key_id >= 0)
+        bv_host = jnp.concatenate([bh1, bh2, bh1, bh2])
+        bv_key = jnp.tile(b.bann_key_id, 4)
+        bv_val = jnp.concatenate([
+            b.bann_value_id, b.bann_value_id,
+            jnp.full(2 * PB, -1, jnp.int32),
+        ])
+        bv_gid = jnp.tile(span_gid_of_bann, 4)
+        ok1 = bk_idx_ok & (bh1 >= 0) & (bh1 < S)
+        ok2 = bk_idx_ok & (bh2 >= 0) & (bh2 < S) & (bh2 != bh1)
+        bv_ok = jnp.concatenate([ok1, ok2, ok1, ok2])
+        bv_mix = _mixb([bv_host, bv_key, bv_val])
+        bv_ts = jnp.tile(b.ts_last[b.bann_span_idx], 4)
+        upd["bann_idx"], upd["bann_idx_pos"], upd["bann_idx_wm"] = \
+            _index_write(
+                state.bann_idx, state.bann_idx_pos, state.bann_idx_wm,
+                _bucket_of(bv_mix, c.bann_buckets),
+                jnp.where(bv_ok, bv_gid, -1),
+                _verify_of(bv_mix), bv_ts, bv_ok, c.bann_depth,
+            )
+
     # -- per-service latency histogram ---------------------------------
     hist = svc_histogram(state)
     svc_ok = mask & (b.service_id >= 0) & (b.service_id < S) & (b.duration >= 0)
@@ -1066,6 +1326,148 @@ def query_trace_ids_by_annotation(
         state.bann_gid, state.bann_key_id, state.bann_value_id,
         state.config.capacity, k,
         svc_id, ann_value_id, bann_key_id, bann_value_id, bann_value_id2,
+        end_ts,
+    )
+
+
+# -- index fast-path query kernels ------------------------------------------
+
+
+def _iq_finish(entries, cnt, wm, row_gid, indexable, ts_last, trace_id,
+               extra_ok, capacity: int, depth: int, k: int, end_ts):
+    """Shared tail: entry liveness via the gid round-trip, span-level
+    filters from the ring, top-k by ts. ``complete`` is True when no
+    probed bucket ever wrapped — then the candidate set provably holds
+    every matching span still resident, and the host can skip the
+    O(ring) scan fallback. For wrapped buckets the returned watermark
+    lets the host decide trust per query (store.base.index_first_topk)."""
+    gid = entries[:, 0]
+    slot = jnp.clip((gid % capacity).astype(jnp.int32), 0, capacity - 1)
+    live = (gid >= 0) & (row_gid[slot] == gid)
+    ok = live & indexable[slot] & extra_ok
+    ts = ts_last[slot]
+    ok &= (ts >= 0) & (ts <= end_ts)
+    mat = _topk_candidates(trace_id[slot], ts, ok, k)
+    return mat, cnt <= depth, wm
+
+
+@partial(jax.jit, static_argnums=(7, 8, 9))
+def _iq_service_impl(entries, pos, wm, row_gid, indexable, trace_id,
+                     ts_last, capacity: int, depth: int, k: int,
+                     svc, end_ts):
+    # Span-name-filtered lookups route through the (service, name)
+    # family (_iq_verify_impl), never through this bucket.
+    svc_i = jnp.asarray(svc, jnp.int32)
+    row = jax.lax.dynamic_slice(
+        entries, (svc_i * depth, jnp.int32(0)), (depth, 3)
+    )
+    cnt = pos[svc_i]
+    ok = jnp.ones(depth, bool)
+    return _iq_finish(row, cnt, wm[svc_i], row_gid, indexable, ts_last,
+                      trace_id, ok, capacity, depth, k, end_ts)
+
+
+@partial(jax.jit, static_argnums=(7, 8, 9, 10))
+def _iq_verify_impl(entries, pos, wm, row_gid, indexable, trace_id,
+                    ts_last, capacity: int, n_buckets: int, depth: int,
+                    k: int, key_parts, end_ts):
+    mixed = _mixb(list(key_parts))
+    b = _bucket_of(mixed, n_buckets)
+    row = jax.lax.dynamic_slice(entries, (b * depth, jnp.int32(0)),
+                                (depth, 3))
+    cnt = pos[b]
+    ver_ok = row[:, 1] == _verify_of(mixed)
+    return _iq_finish(row, cnt, wm[b], row_gid, indexable, ts_last,
+                      trace_id, ver_ok, capacity, depth, k, end_ts)
+
+
+@partial(jax.jit, static_argnums=(7, 8, 9, 10))
+def _iq_verify2_impl(entries, pos, wm, row_gid, indexable, trace_id,
+                     ts_last, capacity: int, n_buckets: int, depth: int,
+                     k: int, key_parts1, key_parts2, end_ts):
+    m1 = _mixb(list(key_parts1))
+    m2 = _mixb(list(key_parts2))
+    b1 = _bucket_of(m1, n_buckets)
+    b2 = _bucket_of(m2, n_buckets)
+    r1 = jax.lax.dynamic_slice(entries, (b1 * depth, jnp.int32(0)),
+                               (depth, 3))
+    r2 = jax.lax.dynamic_slice(entries, (b2 * depth, jnp.int32(0)),
+                               (depth, 3))
+    row = jnp.concatenate([r1, r2])
+    cnt = jnp.maximum(pos[b1], pos[b2])
+    ver_ok = (row[:, 1] == _verify_of(m1)) | (row[:, 1] == _verify_of(m2))
+    return _iq_finish(row, cnt, jnp.maximum(wm[b1], wm[b2]), row_gid,
+                      indexable, ts_last, trace_id, ver_ok, capacity,
+                      depth, k, end_ts)
+
+
+def iquery_trace_ids_by_service(state: StoreState, svc_id, name_lc_id,
+                                end_ts, k: int):
+    """Index fast path for getTraceIdsByName: an O(depth) bucket read
+    (service family, or the (service, span-name) family when a name is
+    given) instead of the O(ring) scan. Returns (candidates [3, k],
+    complete, entry_count); the host falls back to the scan kernel when
+    the bucket wrapped and the result underfills (store.base gating)."""
+    c = state.config
+    if name_lc_id is not None and name_lc_id >= 0:
+        return _iq_verify_impl(
+            state.name_idx, state.name_idx_pos, state.name_idx_wm,
+            state.row_gid, state.indexable, state.trace_id, state.ts_last,
+            c.capacity, c.name_buckets, c.name_depth,
+            min(k, c.name_depth),
+            (jnp.int32(svc_id), jnp.int32(name_lc_id)), end_ts,
+        )
+    return _iq_service_impl(
+        state.svc_idx, state.svc_idx_pos, state.svc_idx_wm,
+        state.row_gid, state.indexable, state.trace_id, state.ts_last,
+        c.capacity, c.svc_depth, min(k, c.svc_depth), svc_id, end_ts,
+    )
+
+
+def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
+                                   ann_value_id, bann_key_id,
+                                   bann_value_id, bann_value_id2,
+                                   end_ts, k: int):
+    """Index fast path for the annotation query (AnnotationsIndex role).
+    Same contract as iquery_trace_ids_by_service."""
+    c = state.config
+    if ann_value_id is not None and ann_value_id >= 0:
+        return _iq_verify_impl(
+            state.ann_idx, state.ann_idx_pos, state.ann_idx_wm,
+            state.row_gid, state.indexable, state.trace_id, state.ts_last,
+            c.capacity, c.ann_buckets, c.ann_depth,
+            min(k, c.ann_depth),
+            (jnp.int32(svc_id), jnp.int32(ann_value_id)), end_ts,
+        )
+    if bann_value_id is None or bann_value_id < 0:
+        bann_value_id = -1
+    if bann_value_id2 is None or bann_value_id2 < 0:
+        bann_value_id2 = -1
+    # A value may be dictionary-keyed in only one of its str/bytes
+    # forms: any non-negative id makes this a VALUED query.
+    if bann_value_id < 0 and bann_value_id2 >= 0:
+        bann_value_id = bann_value_id2
+    if bann_value_id >= 0 and bann_value_id2 < 0:
+        bann_value_id2 = bann_value_id
+    if bann_value_id < 0:
+        # Key-only query: the sentinel-keyed buckets.
+        return _iq_verify_impl(
+            state.bann_idx, state.bann_idx_pos, state.bann_idx_wm,
+            state.row_gid, state.indexable, state.trace_id, state.ts_last,
+            c.capacity, c.bann_buckets, c.bann_depth,
+            min(k, c.bann_depth),
+            (jnp.int32(svc_id), jnp.int32(bann_key_id), jnp.int32(-1)),
+            end_ts,
+        )
+    return _iq_verify2_impl(
+        state.bann_idx, state.bann_idx_pos, state.bann_idx_wm,
+        state.row_gid, state.indexable, state.trace_id, state.ts_last,
+        c.capacity, c.bann_buckets, c.bann_depth,
+        min(k, c.bann_depth),
+        (jnp.int32(svc_id), jnp.int32(bann_key_id),
+         jnp.int32(bann_value_id)),
+        (jnp.int32(svc_id), jnp.int32(bann_key_id),
+         jnp.int32(bann_value_id2)),
         end_ts,
     )
 

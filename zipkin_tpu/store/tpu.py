@@ -45,6 +45,7 @@ from zipkin_tpu.store.base import (
     exist_from_duration_mat,
     fill_pin,
     gather_with_escalation,
+    index_first_topk,
     prune_ttls,
     resolve_annotation_query,
     should_index,
@@ -489,6 +490,21 @@ class TpuSpanStore(SpanStore):
                      for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
             return cands, len(cands) >= k
 
+        def index_fetch(k):
+            with self._rw.read():
+                mat, complete, wm = jax.device_get(
+                    dev.iquery_trace_ids_by_service(
+                        self.state, svc, name_lc, end_ts, k
+                    )
+                )
+            cands = [(int(t), int(ts))
+                     for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
+            return cands, bool(complete), int(wm)
+
+        if self.config.use_index:
+            return index_first_topk(
+                limit, self.config.ann_capacity, index_fetch, fetch
+            )
         return topk_ids_with_escalation(
             limit, self.config.ann_capacity, fetch
         )
@@ -517,7 +533,29 @@ class TpuSpanStore(SpanStore):
                      for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
             return cands, len(cands) >= k
 
+        def index_fetch(k):
+            with self._rw.read():
+                mat, complete, wm = jax.device_get(
+                    dev.iquery_trace_ids_by_annotation(
+                        self.state, svc, ann_value, bann_key, bann_value,
+                        bann_value2, end_ts, k,
+                    )
+                )
+            cands = [(int(t), int(ts))
+                     for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
+            return cands, bool(complete), int(wm)
+
         c = self.config
+        # A name present BOTH as a user-annotation value and as a
+        # binary key matches through either side in the scan (OR
+        # semantics); the index families are per-side, so the rare
+        # mixed case takes the scan.
+        mixed = ann_value >= 0 and bann_key >= 0
+        if c.use_index and not mixed:
+            return index_first_topk(
+                limit, c.ann_capacity + c.bann_capacity, index_fetch,
+                fetch,
+            )
         return topk_ids_with_escalation(
             limit, c.ann_capacity + c.bann_capacity, fetch
         )
