@@ -265,10 +265,14 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
          f"{archive_runs} archive passes)")
 
     # Hand the streamed state to the store so the public query API
-    # (device kernels + host decode) serves the read benchmarks.
+    # (device kernels + host decode) serves the read benchmarks. The
+    # stream bypassed _write_device, so mark the sweep clock dirty: the
+    # first dependency read must run a pending sweep (streaming-join
+    # contract) even though no store-mediated batch was written.
     store.state = state
     store._wp = wp
     store._archived = archived
+    store._batches_since_sweep = 1
     stats = {
         "spans": n_steps * pad_spans,
         "spans_per_s": round(n_steps * pad_spans / dt, 1),

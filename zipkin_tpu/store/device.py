@@ -111,38 +111,42 @@ class StoreConfig(NamedTuple):
         return _next_pow2_int(self.pend_slots or max(1 << 16,
                                                      self.capacity // 4))
 
-    def _derived(self, explicit: int, lo: int, hi: int) -> int:
+    def _derived(self, explicit: int, scale: int, lo: int,
+                 hi: int) -> int:
+        """Derived index geometry: total entries stay O(ring capacity)
+        (the families mirror the rings they index; outsized arrays cost
+        a full copy per step on backends without buffer donation)."""
         return _next_pow2_int(
-            explicit or max(lo, min(hi, self.capacity // 8))
+            explicit or max(lo, min(hi, self.capacity // scale))
         )
 
     @property
     def svc_depth(self) -> int:
-        return self._derived(self.idx_service_depth, 64, 4096)
+        return self._derived(self.idx_service_depth, 64, 64, 4096)
 
     @property
     def name_buckets(self) -> int:
-        return self._derived(self.idx_name_buckets, 256, 8192)
+        return self._derived(self.idx_name_buckets, 32, 256, 8192)
 
     @property
     def name_depth(self) -> int:
-        return self._derived(self.idx_name_depth, 64, 512)
+        return self._derived(self.idx_name_depth, 512, 64, 512)
 
     @property
     def ann_buckets(self) -> int:
-        return self._derived(self.idx_ann_buckets, 256, 16384)
+        return self._derived(self.idx_ann_buckets, 16, 256, 16384)
 
     @property
     def ann_depth(self) -> int:
-        return self._derived(self.idx_ann_depth, 64, 512)
+        return self._derived(self.idx_ann_depth, 512, 64, 512)
 
     @property
     def bann_buckets(self) -> int:
-        return self._derived(self.idx_bann_buckets, 256, 8192)
+        return self._derived(self.idx_bann_buckets, 32, 256, 8192)
 
     @property
     def bann_depth(self) -> int:
-        return self._derived(self.idx_bann_depth, 32, 256)
+        return self._derived(self.idx_bann_depth, 1024, 32, 256)
 
 
 def _next_pow2_int(n: int) -> int:
