@@ -119,6 +119,127 @@ def test_wrapped_bucket_falls_back_to_scan():
         ))
 
 
+def test_trace_membership_fast_path_matches_scan():
+    """Whole-trace fetch and durations through the gid buckets must
+    equal the full-ring scan results exactly."""
+    fast, scan = _pair(SPANS)
+    tids = sorted({s.trace_id for s in SPANS})[:20]
+    got = fast.get_spans_by_trace_ids(tids)
+    want = scan.get_spans_by_trace_ids(tids)
+    assert [sorted(s.id for s in t) for t in got] == \
+        [sorted(s.id for s in t) for t in want]
+    assert got == want  # full span equality incl. annotations
+    assert fast.get_traces_duration(tids) == scan.get_traces_duration(tids)
+    assert fast.traces_exist(tids + [424242]) == \
+        scan.traces_exist(tids + [424242])
+
+
+def test_hot_trace_beyond_bucket_depth_falls_back():
+    """A trace with more spans than TRACE_SPAN_DEPTH keeps its bucket
+    gate false (its own entries displace each other while resident), so
+    reads must fall back to the scan and stay exact."""
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+    from zipkin_tpu.store.device import StoreConfig
+
+    cfg = _cfg(True)
+    assert StoreConfig.TRACE_SPAN_DEPTH == 32
+    ep = Endpoint(5, 80, "hotsvc")
+    hot = [
+        Span(555, "op", i + 1, None,
+             (Annotation(100 + i, "sr", ep), Annotation(200 + i, "ss", ep)),
+             ())
+        for i in range(50)  # > TRACE_SPAN_DEPTH
+    ]
+    fast, scan = TpuSpanStore(cfg), TpuSpanStore(_cfg(False))
+    for st in (fast, scan):
+        st.apply(hot)
+    got = fast.get_spans_by_trace_ids([555])
+    want = scan.get_spans_by_trace_ids([555])
+    assert got and len(got[0]) == 50
+    assert got == want
+    assert fast.get_traces_duration([555]) == scan.get_traces_duration([555])
+
+
+def test_trace_membership_after_eviction():
+    """Ring-lap survivors read identically through fast path and scan."""
+    fast = TpuSpanStore(_cfg(True, capacity=128, ann_capacity=512,
+                             bann_capacity=256))
+    scan = TpuSpanStore(_cfg(False, capacity=128, ann_capacity=512,
+                             bann_capacity=256))
+    spans = [s for t in generate_traces(n_traces=60, max_depth=3,
+                                        n_services=4) for s in t]
+    for st in (fast, scan):
+        st.apply(spans)
+    tids = sorted({s.trace_id for s in spans})
+    assert fast.traces_exist(tids) == scan.traces_exist(tids)
+    survivors = sorted(scan.traces_exist(tids))[:10]
+    assert fast.get_spans_by_trace_ids(survivors) == \
+        scan.get_spans_by_trace_ids(survivors)
+    assert fast.get_traces_duration(survivors) == \
+        scan.get_traces_duration(survivors)
+
+
+def test_duplicate_trace_ids_in_request():
+    """Duplicated request ids must not duplicate spans or wedge the
+    index fast path's cap escalation (qids are uniqued; reconstruction
+    is per request id)."""
+    fast, scan = _pair(SPANS)
+    tid = SPANS[0].trace_id
+    got = fast.get_spans_by_trace_ids([tid] * 10)
+    want = scan.get_spans_by_trace_ids([tid] * 10)
+    assert len(got) == len(want) == 10
+    assert got == want
+    assert len({len(t) for t in got}) == 1  # all copies identical
+    assert fast.get_traces_duration([tid] * 10) == \
+        scan.get_traces_duration([tid] * 10)
+
+
+def test_pre_index_snapshot_poisons_trust(tmp_path):
+    """Restoring a snapshot that predates the index families must not
+    let empty zero-cursor buckets claim completeness: reads fall back
+    to the scans and every restored span stays visible."""
+    import json
+    import os
+
+    import numpy as np
+
+    from zipkin_tpu import checkpoint
+
+    store = TpuSpanStore(_cfg(True))
+    spans = [s for t in generate_traces(n_traces=6, max_depth=3,
+                                        n_services=4) for s in t]
+    store.apply(spans)
+    path = str(tmp_path / "preindex")
+    checkpoint.save(store, path)
+
+    state_file = os.path.join(path, "state.npz")
+    data = dict(np.load(state_file))
+    for k in list(data):
+        if k.startswith(("svc_idx", "name_idx", "ann_idx", "bann_idx",
+                         "tr_span", "tr_ann", "tr_bann")):
+            del data[k]
+    np.savez_compressed(state_file, **data)
+    meta_file = os.path.join(path, "meta.json")
+    with open(meta_file) as f:
+        meta = json.load(f)
+    meta["revision"] = 4
+    for k in list(meta["config"]):
+        if k.startswith("idx_"):
+            meta["config"].pop(k)
+    with open(meta_file, "w") as f:
+        json.dump(meta, f)
+
+    restored = checkpoint.load(path)
+    tids = sorted({s.trace_id for s in spans})
+    assert restored.traces_exist(tids) == store.traces_exist(tids)
+    assert restored.get_spans_by_trace_ids(tids[:3]) == \
+        store.get_spans_by_trace_ids(tids[:3])
+    end_ts = max(s.last_timestamp for s in spans if s.last_timestamp) + 1
+    svc = sorted(store.get_all_service_names())[0]
+    assert _ids(restored.get_trace_ids_by_name(svc, None, end_ts, 10)) \
+        == _ids(store.get_trace_ids_by_name(svc, None, end_ts, 10))
+
+
 def test_eviction_through_index():
     """Evicted spans must vanish from index results (gid round-trip
     liveness), exactly as they vanish from the scan."""

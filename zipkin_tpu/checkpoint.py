@@ -30,7 +30,7 @@ _STATE_FILE = "state.npz"
 _META_FILE = "meta.json"
 _PINS_FILE = "pins.pkl"
 # Bump when the StoreState schema changes in a way load() must adapt to.
-_REVISION = 4
+_REVISION = 5
 
 
 def _dict_dump(d) -> list:
@@ -221,7 +221,13 @@ def load(path: str, mesh=None):
     # new spans arrive, and any SAVED state's links were already folded
     # into dep_moments/dep_banks by the pre-upgrade archive policy.
     known = set(dev.StoreState._FIELDS)
-    legacy = meta.get("revision", 1) < 4
+    revision = meta.get("revision", 1)
+    legacy = revision < 4
+    # Snapshots predating (parts of) the index families would restore
+    # empty buckets whose zero cursors claim completeness — hiding
+    # every restored span from the fast paths. Poison index trust so
+    # the exact scan kernels serve instead (load() applies below).
+    pre_index = revision < 5
     upd = {k: v for k, v in upd.items() if k in known}
     if legacy:
         _migrate_legacy_live_links(data, upd, config, n_shards)
@@ -250,6 +256,10 @@ def load(path: str, mesh=None):
         }
         with store._rw.write():
             store.inner.states = store.inner.states.replace(**upd)
+            if pre_index:
+                store.inner.states = dev.poison_index_trust(
+                    store.inner.states
+                )
             if legacy:
                 store.inner.states = _sharded_rebuild_tab(
                     mesh, store.inner.states
@@ -262,6 +272,8 @@ def load(path: str, mesh=None):
         return store
     with store._rw.write():
         store.state = store.state.replace(**upd)
+        if pre_index:
+            store.state = dev.poison_index_trust(store.state)
         if legacy:
             # The pre-rev-4 schema had no span table: re-insert resident
             # spans so post-restore children still find their parents.

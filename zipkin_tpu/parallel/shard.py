@@ -667,7 +667,8 @@ class ShardedSpanStore:
     def _sorted_qids(self, trace_ids) -> np.ndarray:
         from zipkin_tpu.columnar.encode import to_signed64
 
-        return np.sort(
+        # Unique for the same reason as TpuSpanStore._sorted_qids.
+        return np.unique(
             np.asarray([to_signed64(t) for t in trace_ids], np.int64)
         )
 

@@ -91,6 +91,11 @@ class StoreConfig(NamedTuple):
     idx_ann_depth: int = 0
     idx_bann_buckets: int = 0
     idx_bann_depth: int = 0
+    # Trace-membership gid index (whole-trace fetch + durations).
+    # buckets * depth >= 2 * ring capacity keeps the exactness gate
+    # (everything a bucket displaced is already evicted) true in steady
+    # state — see _gid_index_write.
+    idx_trace_buckets: int = 0
     # Route ingest scatter-adds through the VMEM-resident pallas
     # histogram kernels (ops/pallas_kernels.py) instead of XLA scatter.
     # Benchmarked on the real chip by bench.py --compare-kernels; arrays
@@ -147,6 +152,20 @@ class StoreConfig(NamedTuple):
     @property
     def bann_depth(self) -> int:
         return self._derived(self.idx_bann_depth, 1024, 32, 256)
+
+    # Trace-membership family: depths are fixed small constants (a
+    # trace's rows per family), buckets scale so buckets*depth covers
+    # 2x the corresponding ring.
+    TRACE_SPAN_DEPTH = 32
+    TRACE_ANN_DEPTH = 64
+    TRACE_BANN_DEPTH = 32
+
+    @property
+    def trace_buckets(self) -> int:
+        return _next_pow2_int(
+            self.idx_trace_buckets
+            or max(256, 2 * self.capacity // self.TRACE_SPAN_DEPTH)
+        )
 
 
 def _next_pow2_int(n: int) -> int:
@@ -252,6 +271,20 @@ class StoreState:
     bann_idx: jnp.ndarray
     bann_idx_pos: jnp.ndarray
     bann_idx_wm: jnp.ndarray
+    # Trace-membership family: [B*K] i64 row gids bucketed by trace-id
+    # hash, one sub-family per ring; wm = max DISPLACED gid. A bucket
+    # provably holds every RESIDENT row of its traces when everything
+    # it ever displaced is already evicted (wm < write_pos - capacity)
+    # — the exactness gate for whole-trace fetch and durations.
+    tr_span_idx: jnp.ndarray
+    tr_span_pos: jnp.ndarray
+    tr_span_wm: jnp.ndarray
+    tr_ann_idx: jnp.ndarray
+    tr_ann_pos: jnp.ndarray
+    tr_ann_wm: jnp.ndarray
+    tr_bann_idx: jnp.ndarray
+    tr_bann_pos: jnp.ndarray
+    tr_bann_wm: jnp.ndarray
     svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
     svc_span_counts: jnp.ndarray  # [S] f32
     ann_svc_counts: jnp.ndarray  # [S] f32 — services seen on any annotation
@@ -279,6 +312,9 @@ class StoreState:
         "name_idx", "name_idx_pos", "name_idx_wm",
         "ann_idx", "ann_idx_pos", "ann_idx_wm",
         "bann_idx", "bann_idx_pos", "bann_idx_wm",
+        "tr_span_idx", "tr_span_pos", "tr_span_wm",
+        "tr_ann_idx", "tr_ann_pos", "tr_ann_wm",
+        "tr_bann_idx", "tr_bann_pos", "tr_bann_wm",
         "svc_hist", "svc_span_counts", "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
         "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
@@ -350,19 +386,31 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         pend_tsl=jnp.zeros(c.pending_slots, jnp.int64),
         pend_pos=jnp.int64(0),
         svc_idx=jnp.full((S * c.svc_depth, 3), -1, jnp.int64),
-        svc_idx_pos=jnp.zeros(S, jnp.int32),
+        svc_idx_pos=jnp.zeros(S, jnp.int64),
         svc_idx_wm=jnp.full(S, I64_MIN, jnp.int64),
         name_idx=jnp.full((c.name_buckets * c.name_depth, 3), -1,
                           jnp.int64),
-        name_idx_pos=jnp.zeros(c.name_buckets, jnp.int32),
+        name_idx_pos=jnp.zeros(c.name_buckets, jnp.int64),
         name_idx_wm=jnp.full(c.name_buckets, I64_MIN, jnp.int64),
         ann_idx=jnp.full((c.ann_buckets * c.ann_depth, 3), -1, jnp.int64),
-        ann_idx_pos=jnp.zeros(c.ann_buckets, jnp.int32),
+        ann_idx_pos=jnp.zeros(c.ann_buckets, jnp.int64),
         ann_idx_wm=jnp.full(c.ann_buckets, I64_MIN, jnp.int64),
         bann_idx=jnp.full((c.bann_buckets * c.bann_depth, 3), -1,
                           jnp.int64),
-        bann_idx_pos=jnp.zeros(c.bann_buckets, jnp.int32),
+        bann_idx_pos=jnp.zeros(c.bann_buckets, jnp.int64),
         bann_idx_wm=jnp.full(c.bann_buckets, I64_MIN, jnp.int64),
+        tr_span_idx=jnp.full(c.trace_buckets * c.TRACE_SPAN_DEPTH, -1,
+                             jnp.int64),
+        tr_span_pos=jnp.zeros(c.trace_buckets, jnp.int64),
+        tr_span_wm=jnp.full(c.trace_buckets, I64_MIN, jnp.int64),
+        tr_ann_idx=jnp.full(c.trace_buckets * c.TRACE_ANN_DEPTH, -1,
+                            jnp.int64),
+        tr_ann_pos=jnp.zeros(c.trace_buckets, jnp.int64),
+        tr_ann_wm=jnp.full(c.trace_buckets, I64_MIN, jnp.int64),
+        tr_bann_idx=jnp.full(c.trace_buckets * c.TRACE_BANN_DEPTH, -1,
+                             jnp.int64),
+        tr_bann_pos=jnp.zeros(c.trace_buckets, jnp.int64),
+        tr_bann_wm=jnp.full(c.trace_buckets, I64_MIN, jnp.int64),
         svc_hist=Q.init(
             shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
             dtype=jnp.int32,
@@ -702,6 +750,36 @@ def _index_write(entries, pos, wm, bucket, gid, verify, ts, valid,
     return entries, pos, wm
 
 
+def _gid_index_write(entries, pos, wm, bucket, gid, valid, depth: int):
+    """Append row gids to per-bucket FIFO rings; ``wm`` tracks the max
+    gid ever displaced. Ring overwrite order is oldest-first, so once
+    wm < (ring write_pos - ring capacity), everything a bucket lost is
+    already evicted and the bucket provably holds every RESIDENT row of
+    its traces — the query-time exactness gate. Sizing buckets*depth >=
+    2x the ring keeps the gate true in steady state (a displaced entry
+    is ~2 retention windows old); only a single trace hotter than
+    ``depth`` rows per family keeps its own gate false forever, which
+    the scan fallback covers."""
+    n_b = pos.shape[0]
+    rank = _fifo_ranks(bucket, valid)
+    b_c = jnp.clip(bucket, 0, n_b - 1)
+    oob_b = jnp.where(valid, b_c, n_b)
+    cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
+        1, mode="drop")[:n_b]
+    keep = valid & (rank >= cnt[b_c] - depth)
+    slot = b_c * depth + ((pos[b_c] + rank) % depth)
+    idx = jnp.where(keep, slot, entries.shape[0])
+    old = entries[jnp.clip(idx, 0, entries.shape[0] - 1)]
+    old_gid = jnp.where(keep & (old >= 0), old, I64_MIN)
+    dropped_gid = jnp.where(valid & ~keep, jnp.asarray(gid, jnp.int64),
+                            I64_MIN)
+    wm = wm.at[oob_b].max(jnp.maximum(old_gid, dropped_gid), mode="drop")
+    entries = entries.at[idx].set(jnp.asarray(gid, jnp.int64),
+                                  mode="drop")
+    pos = pos.at[oob_b].add(1, mode="drop")
+    return entries, pos, wm
+
+
 def _span_host_range(ann_svc, ann_span_idx, valid_a, n_spans: int):
     """Per span: (min, max) service over its annotation hosts — the
     span's host SET for spans with at most two distinct hosts (the
@@ -857,6 +935,28 @@ def dep_close_bucket(state: "StoreState") -> "StoreState":
         dep_window_ts=jnp.where(rotate, empty_ts, window_ts),
         pend_key=cleared,
     )
+
+
+def poison_index_trust(state: "StoreState") -> "StoreState":
+    """Mark every index bucket permanently untrusted (cursor past depth,
+    watermark at +inf), forcing all reads through the exact scan
+    kernels. Used when restoring snapshots that predate the index
+    families: empty buckets with zero cursors would otherwise claim
+    completeness and silently hide every restored span from the fast
+    paths. New writes still append (cursors keep counting), but trust
+    never returns for a poisoned bucket — the scan fallback serves the
+    store's remaining lifetime, which is exactly the pre-index behavior
+    the snapshot was taken under."""
+    big = jnp.int64(1) << 60
+    upd = {}
+    for fam in ("svc_idx", "name_idx", "ann_idx", "bann_idx",
+                "tr_span", "tr_ann", "tr_bann"):
+        pos = getattr(state, f"{fam}_pos")
+        wm = getattr(state, f"{fam}_wm")
+        # Explicit i64 (a legacy snapshot may restore i32 cursors).
+        upd[f"{fam}_pos"] = jnp.full(pos.shape, big, jnp.int64)
+        upd[f"{fam}_wm"] = jnp.full(wm.shape, I64_MAX, jnp.int64)
+    return state.replace(**upd)
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -1100,6 +1200,24 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
                 _bucket_of(bv_mix, c.bann_buckets),
                 jnp.where(bv_ok, bv_gid, -1),
                 _verify_of(bv_mix), bv_ts, bv_ok, c.bann_depth,
+            )
+        # Trace-membership family: row gids bucketed by trace-id hash,
+        # one sub-family per ring (whole-trace fetch + durations).
+        tb = _bucket_of(_mixb([b.trace_id]), c.trace_buckets)
+        upd["tr_span_idx"], upd["tr_span_pos"], upd["tr_span_wm"] = \
+            _gid_index_write(
+                state.tr_span_idx, state.tr_span_pos, state.tr_span_wm,
+                tb, gids, mask, c.TRACE_SPAN_DEPTH,
+            )
+        upd["tr_ann_idx"], upd["tr_ann_pos"], upd["tr_ann_wm"] = \
+            _gid_index_write(
+                state.tr_ann_idx, state.tr_ann_pos, state.tr_ann_wm,
+                tb[b.ann_span_idx], a_gids, mask_a, c.TRACE_ANN_DEPTH,
+            )
+        upd["tr_bann_idx"], upd["tr_bann_pos"], upd["tr_bann_wm"] = \
+            _gid_index_write(
+                state.tr_bann_idx, state.tr_bann_pos, state.tr_bann_wm,
+                tb[b.bann_span_idx], bb_gids, mask_b, c.TRACE_BANN_DEPTH,
             )
 
     # -- per-service latency histogram ---------------------------------
@@ -1473,6 +1591,146 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
         (jnp.int32(svc_id), jnp.int32(bann_key_id),
          jnp.int32(bann_value_id2)),
         end_ts,
+    )
+
+
+@partial(jax.jit, static_argnums=(8, 9))
+def _iq_durations_impl(entries, pos, wm, trace_id, row_gid, ts_first,
+                       ts_last, write_pos, capacity: int, depth: int,
+                       sorted_qids):
+    nq = sorted_qids.shape[0]
+    B = pos.shape[0]
+    qb = _bucket_of(_mixb([sorted_qids]), B)
+    rows = (qb[:, None] * depth
+            + jnp.arange(depth, dtype=jnp.int32)[None, :])
+    gid = entries[rows.reshape(-1)].reshape(nq, depth)
+    slot = jnp.clip((gid % capacity).astype(jnp.int32), 0, capacity - 1)
+    live = (gid >= 0) & (row_gid[slot] == gid)
+    match = live & (trace_id[slot] == sorted_qids[:, None])
+    tf = ts_first[slot]
+    tl = ts_last[slot]
+    has_ts = match & (tf >= 0)
+    firsts = jnp.where(has_ts, tf, I64_MAX).min(axis=1)
+    lasts = jnp.where(match & (tl >= 0), tl, I64_MIN).max(axis=1)
+    gate = (pos[qb] <= depth) | (wm[qb] < write_pos - capacity)
+    mat = jnp.stack([
+        match.any(axis=1).astype(jnp.int64),
+        has_ts.any(axis=1).astype(jnp.int64),
+        firsts, lasts,
+    ])
+    return mat, gate.all()
+
+
+def iquery_durations(state: StoreState, sorted_qids):
+    """Trace-membership fast path for getTracesDuration/tracesExist:
+    candidate rows come from the queried traces' gid buckets (nq*depth
+    rows) instead of a 4-scatter pass over the full span ring. Returns
+    (mat [4, nq] — same layout as query_durations — , exact) where
+    ``exact`` requires every queried bucket to pass the displaced-gid
+    gate; the host falls back to the scan kernel otherwise."""
+    c = state.config
+    return _iq_durations_impl(
+        state.tr_span_idx, state.tr_span_pos, state.tr_span_wm,
+        state.trace_id, state.row_gid, state.ts_first, state.ts_last,
+        state.write_pos, c.capacity, c.TRACE_SPAN_DEPTH, sorted_qids,
+    )
+
+
+@partial(jax.jit, static_argnums=(10,))
+def _iq_gather_impl(
+    tr_entries, tr_pos, tr_wm,
+    span_cols, ann_cols, bann_cols, sorted_qids,
+    write_pos, ann_write_pos, bann_write_pos,
+    statics,
+):
+    (capacity, ann_capacity, bann_capacity, KS, KA, KB,
+     k_spans, k_anns, k_banns) = statics
+    trace_id = span_cols[0]
+    row_gid = span_cols[-1]
+    ann_gid = ann_cols[0]
+    bann_gid = bann_cols[0]
+    nq = sorted_qids.shape[0]
+    B = tr_pos[0].shape[0]
+    qb = _bucket_of(_mixb([sorted_qids]), B)
+
+    def family(entries, pos, wm, depth, ring_wp, ring_cap):
+        rows = (qb[:, None] * depth
+                + jnp.arange(depth, dtype=jnp.int32)[None, :])
+        gid = entries[rows.reshape(-1)].reshape(nq, depth)
+        gate = (pos[qb] <= depth) | (wm[qb] < ring_wp - ring_cap)
+        return gid, gate.all()
+
+    # Span rows: direct liveness + trace match.
+    s_gid, gate_s = family(tr_entries[0], tr_pos[0], tr_wm[0], KS,
+                           write_pos, capacity)
+    s_slot = jnp.clip((s_gid % capacity).astype(jnp.int32), 0,
+                      capacity - 1)
+    s_ok = ((s_gid >= 0) & (row_gid[s_slot] == s_gid)
+            & (trace_id[s_slot] == sorted_qids[:, None]))
+    count_s = s_ok.sum(dtype=jnp.int64)
+    key_s = jnp.where(s_ok, I64_MAX - s_gid, jnp.int64(-1)).reshape(-1)
+    vals_s, sel_s = jax.lax.top_k(key_s, k_spans)  # oldest gid first
+    sslot = s_slot.reshape(-1)[sel_s]
+    span_mat = jnp.stack([c[sslot].astype(jnp.int64) for c in span_cols])
+    span_mat = jnp.where((vals_s >= 0)[None, :], span_mat, -1)
+
+    def ragged(entries, pos, wm, depth, ring_wp, ring_cap, owner_col,
+               cols, k):
+        """Annotation/binary rows: entry validity = the ring slot still
+        holds this position (overwrite order) + owning span live and in
+        the queried set."""
+        gid, gate = family(entries, pos, wm, depth, ring_wp, ring_cap)
+        slot = jnp.clip((gid % ring_cap).astype(jnp.int32), 0,
+                        ring_cap - 1)
+        fresh = (gid >= 0) & (gid >= ring_wp - ring_cap)
+        owner = owner_col[slot]
+        oslot = jnp.clip((owner % capacity).astype(jnp.int32), 0,
+                         capacity - 1)
+        ok = (fresh & (owner >= 0) & (row_gid[oslot] == owner)
+              & (trace_id[oslot] == sorted_qids[:, None]))
+        count = ok.sum(dtype=jnp.int64)
+        key = jnp.where(ok, I64_MAX - gid, jnp.int64(-1)).reshape(-1)
+        vals, sel = jax.lax.top_k(key, k)
+        rslot = slot.reshape(-1)[sel]
+        mat = jnp.stack([c[rslot].astype(jnp.int64) for c in cols])
+        return count, jnp.where((vals >= 0)[None, :], mat, -1), gate
+
+    count_a, ann_mat, gate_a = ragged(
+        tr_entries[1], tr_pos[1], tr_wm[1], KA, ann_write_pos,
+        ann_capacity, ann_gid, ann_cols, k_anns,
+    )
+    count_b, bann_mat, gate_b = ragged(
+        tr_entries[2], tr_pos[2], tr_wm[2], KB, bann_write_pos,
+        bann_capacity, bann_gid, bann_cols, k_banns,
+    )
+    counts = jnp.stack([count_s, count_a, count_b])
+    return counts, span_mat, ann_mat, bann_mat, gate_s & gate_a & gate_b
+
+
+def iquery_gather_trace_rows(
+    state: StoreState, sorted_qids, k_spans: int, k_anns: int,
+    k_banns: int,
+):
+    """Trace-membership fast path for whole-trace materialization: the
+    same four-array contract as gather_trace_rows plus an ``exact``
+    flag; candidates come from the queried traces' gid buckets instead
+    of full-ring scans. The host falls back to gather_trace_rows when
+    any queried bucket fails the displaced-gid gate (hot traces beyond
+    the per-family depths, or shuffled arrival near the gate)."""
+    c = state.config
+    statics = (c.capacity, c.ann_capacity, c.bann_capacity,
+               c.TRACE_SPAN_DEPTH, c.TRACE_ANN_DEPTH,
+               c.TRACE_BANN_DEPTH, k_spans, k_anns, k_banns)
+    return _iq_gather_impl(
+        (state.tr_span_idx, state.tr_ann_idx, state.tr_bann_idx),
+        (state.tr_span_pos, state.tr_ann_pos, state.tr_bann_pos),
+        (state.tr_span_wm, state.tr_ann_wm, state.tr_bann_wm),
+        tuple(getattr(state, col) for col in SPAN_MAT_COLS),
+        tuple(getattr(state, col) for col in ANN_MAT_COLS),
+        tuple(getattr(state, col) for col in BANN_MAT_COLS),
+        sorted_qids,
+        state.write_pos, state.ann_write_pos, state.bann_write_pos,
+        statics,
     )
 
 

@@ -569,17 +569,32 @@ class TpuSpanStore(SpanStore):
         return {to_signed64(t): t for t in trace_ids}
 
     def _sorted_qids(self, trace_ids: Sequence[int]) -> np.ndarray:
-        return np.sort(
+        # Unique: duplicated request ids would double-count bucket
+        # candidates on the index fast path (result duplication, and the
+        # cap-escalation loop can never converge); downstream decode is
+        # keyed by trace id, so duplicates reconstruct per request id.
+        return np.unique(
             np.asarray([to_signed64(t) for t in trace_ids], np.int64)
         )
+
+    def _durations_mat(self, qids: np.ndarray) -> np.ndarray:
+        """[4, nq] duration matrix: trace-membership fast path when its
+        exactness gate holds, the full-ring scan otherwise."""
+        with self._rw.read():
+            if self.config.use_index:
+                mat, exact = jax.device_get(
+                    dev.iquery_durations(self.state, qids)
+                )
+                if exact:
+                    return mat
+            return jax.device_get(dev.query_durations(self.state, qids))
 
     def traces_exist(self, trace_ids: Sequence[int]) -> Set[int]:
         if not trace_ids:
             return set()
         canon = self._canon_ids(trace_ids)
         qids = self._sorted_qids(trace_ids)
-        with self._rw.read():
-            mat = jax.device_get(dev.query_durations(self.state, qids))
+        mat = self._durations_mat(qids)
         return exist_from_duration_mat(canon, qids, mat[0], self.pins,
                                        self._lock)
 
@@ -589,17 +604,19 @@ class TpuSpanStore(SpanStore):
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
             st = self.state
+            payload = None
+            if self.config.use_index:
+                payload = self._gather_via_index(st, qids)
+            if payload is None:
+                def fetch(k_s, k_a, k_b):
+                    counts, s_m, a_m, b_m = jax.device_get(
+                        dev.gather_trace_rows(st, qids, k_s, k_a, k_b)
+                    )
+                    n_s, n_a, n_b = (int(x) for x in counts)
+                    return n_s, n_a, n_b, (n_s, n_a, n_b, s_m, a_m, b_m)
 
-            def fetch(k_s, k_a, k_b):
-                counts, s_m, a_m, b_m = jax.device_get(
-                    dev.gather_trace_rows(st, qids, k_s, k_a, k_b)
-                )
-                n_s, n_a, n_b = (int(x) for x in counts)
-                return n_s, n_a, n_b, (n_s, n_a, n_b, s_m, a_m, b_m)
-
-            n_s, n_a, n_b, span_mat, ann_mat, bann_mat = (
-                gather_with_escalation(self.config, fetch)
-            )
+                payload = gather_with_escalation(self.config, fetch)
+            n_s, n_a, n_b, span_mat, ann_mat, bann_mat = payload
         spans = self._decode_gathered(
             n_s, n_a, n_b, span_mat, ann_mat, bann_mat
         )
@@ -625,6 +642,35 @@ class TpuSpanStore(SpanStore):
             self.codec, n_s, n_a, n_b, span_mat, ann_mat, bann_mat
         )
 
+    def _gather_via_index(self, st, qids: np.ndarray):
+        """Whole-trace gather through the trace-membership buckets (see
+        dev.iquery_gather_trace_rows). Returns the gather payload, or
+        None when any queried bucket fails its exactness gate — the
+        caller then runs the full-ring scan gather. Candidate volume is
+        bounded by nq x per-family depth, so one cap escalation covers
+        everything the buckets can hold."""
+        from zipkin_tpu.store.base import GATHER_K0, escalate_cap
+
+        c = self.config
+        max_s = min(len(qids) * c.TRACE_SPAN_DEPTH, c.capacity)
+        max_a = min(len(qids) * c.TRACE_ANN_DEPTH, c.ann_capacity)
+        max_b = min(len(qids) * c.TRACE_BANN_DEPTH, c.bann_capacity)
+        k_s = min(GATHER_K0, max_s)
+        k_a = min(2 * GATHER_K0, max_a)
+        k_b = min(GATHER_K0, max_b)
+        while True:
+            counts, s_m, a_m, b_m, exact = jax.device_get(
+                dev.iquery_gather_trace_rows(st, qids, k_s, k_a, k_b)
+            )
+            if not exact:
+                return None
+            n_s, n_a, n_b = (int(x) for x in counts)
+            if n_s <= k_s and n_a <= k_a and n_b <= k_b:
+                return n_s, n_a, n_b, s_m, a_m, b_m
+            k_s = escalate_cap(n_s, k_s, max_s)
+            k_a = escalate_cap(n_a, k_a, max_a)
+            k_b = escalate_cap(n_b, k_b, max_b)
+
     def get_traces_duration(
         self, trace_ids: Sequence[int]
     ) -> List[TraceIdDuration]:
@@ -632,8 +678,7 @@ class TpuSpanStore(SpanStore):
             return []
         canon = self._canon_ids(trace_ids)
         qids = self._sorted_qids(trace_ids)
-        with self._rw.read():
-            mat = jax.device_get(dev.query_durations(self.state, qids))
+        mat = self._durations_mat(qids)
         return durations_from_mat(trace_ids, canon, qids, mat, self.pins,
                                   self._lock)
 
