@@ -501,6 +501,81 @@ class ShardedSpanStore:
 
         return self._kernel(("durations",), build)
 
+    def _iq_durations(self):
+        """Trace-membership fast path (dev.iquery_durations) with the
+        cross-shard min/max merge; ``exact`` requires every shard's
+        queried buckets to pass the displaced-gid gate."""
+
+        def build():
+            def fn(state, qids):
+                st = self._unstack(state)
+                mat, exact = dev.iquery_durations(st, qids)
+                merged = jnp.stack([
+                    jax.lax.pmax(mat[0], self.axis),
+                    jax.lax.pmax(mat[1], self.axis),
+                    jax.lax.pmin(mat[2], self.axis),
+                    jax.lax.pmax(mat[3], self.axis),
+                ])
+                all_exact = jax.lax.pmin(
+                    exact.astype(jnp.int32), self.axis
+                )
+                return merged, all_exact
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
+                out_specs=(P(), P()), check_vma=False,
+            ))
+
+        return self._kernel(("idurations",), build)
+
+    def _durations_mat(self, qids):
+        with self._rw.read():
+            if self.config.use_index:
+                mat, exact = jax.device_get(
+                    self._iq_durations()(self.states, qids)
+                )
+                if exact:
+                    return mat
+            return jax.device_get(self._q_durations()(self.states, qids))
+
+    def _iq_gather(self, k_s: int, k_a: int, k_b: int):
+        """Per-shard trace-membership gather (dev.iquery_gather_trace_rows)
+        + a cross-shard AND of the exactness gates."""
+
+        def build():
+            def fn(state, qids):
+                st = self._unstack(state)
+                counts, s, a, b, exact = dev.iquery_gather_trace_rows(
+                    st, qids, k_s, k_a, k_b
+                )
+                all_exact = jax.lax.pmin(
+                    exact.astype(jnp.int32), self.axis
+                )
+                return counts[None], s[None], a[None], b[None], all_exact
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
+                out_specs=(P(self.axis),) * 4 + (P(),), check_vma=False,
+            ))
+
+        return self._kernel(("igather", k_s, k_a, k_b), build)
+
+    def _gather_via_index(self, qids):
+        """Sharded analogue of TpuSpanStore._gather_via_index: returns
+        the per-shard gather payload, or None when any shard's queried
+        bucket fails its gate (caller scans)."""
+        from zipkin_tpu.store.base import index_gather_with_escalation
+
+        def fetch(k_s, k_a, k_b):
+            counts, s_m, a_m, b_m, exact = jax.device_get(
+                self._iq_gather(k_s, k_a, k_b)(self.states, qids)
+            )
+            return (bool(exact), int(counts[:, 0].max()),
+                    int(counts[:, 1].max()), int(counts[:, 2].max()),
+                    (counts, s_m, a_m, b_m))
+
+        return index_gather_with_escalation(self.config, len(qids), fetch)
+
     def _q_gather(self, k_s: int, k_a: int, k_b: int):
         def build():
             def fn(state, qids):
@@ -681,8 +756,7 @@ class ShardedSpanStore:
         qids = self._sorted_qids(trace_ids)
         from zipkin_tpu.store.base import exist_from_duration_mat
 
-        with self._rw.read():
-            mat = jax.device_get(self._q_durations()(self.states, qids))
+        mat = self._durations_mat(qids)
         return exist_from_duration_mat(canon, qids, mat[0], self.pins,
                                        self._lock)
 
@@ -694,8 +768,7 @@ class ShardedSpanStore:
             return []
         canon = {to_signed64(t): t for t in trace_ids}
         qids = self._sorted_qids(trace_ids)
-        with self._rw.read():
-            mat = jax.device_get(self._q_durations()(self.states, qids))
+        mat = self._durations_mat(qids)
         return durations_from_mat(trace_ids, canon, qids, mat, self.pins,
                                   self._lock)
 
@@ -712,17 +785,21 @@ class ShardedSpanStore:
 
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
+            payload = None
+            if self.config.use_index:
+                payload = self._gather_via_index(qids)
+            if payload is None:
+                def fetch(k_s, k_a, k_b):
+                    counts, s_m, a_m, b_m = jax.device_get(
+                        self._q_gather(k_s, k_a, k_b)(self.states, qids)
+                    )
+                    return (int(counts[:, 0].max()),
+                            int(counts[:, 1].max()),
+                            int(counts[:, 2].max()),
+                            (counts, s_m, a_m, b_m))
 
-            def fetch(k_s, k_a, k_b):
-                counts, s_m, a_m, b_m = jax.device_get(
-                    self._q_gather(k_s, k_a, k_b)(self.states, qids)
-                )
-                return (int(counts[:, 0].max()), int(counts[:, 1].max()),
-                        int(counts[:, 2].max()), (counts, s_m, a_m, b_m))
-
-            counts, s_m, a_m, b_m = gather_with_escalation(
-                self.config, fetch
-            )
+                payload = gather_with_escalation(self.config, fetch)
+            counts, s_m, a_m, b_m = payload
         spans = []
         for sh in range(self.n):
             spans.extend(decode_gathered(

@@ -312,6 +312,33 @@ def gather_with_escalation(config, fetch, k0: int = GATHER_K0):
         k_b = escalate_cap(n_b, k_b, config.bann_capacity)
 
 
+def index_gather_with_escalation(config, nq: int, fetch):
+    """Cap-escalating retry for the trace-membership gather fast path,
+    shared by the single-device and sharded stores (same reasoning as
+    gather_with_escalation: one policy, aligned compile caches).
+    ``fetch(k_s, k_a, k_b)`` returns (exact, n_s, n_a, n_b, payload);
+    returns the payload, or None the moment any queried bucket fails
+    its exactness gate (callers then run the scan gather). Caps are
+    bounded by nq x the per-family bucket depths — the most candidates
+    the buckets can hold for the request."""
+    c = config
+    max_s = min(nq * c.TRACE_SPAN_DEPTH, c.capacity)
+    max_a = min(nq * c.TRACE_ANN_DEPTH, c.ann_capacity)
+    max_b = min(nq * c.TRACE_BANN_DEPTH, c.bann_capacity)
+    k_s = min(GATHER_K0, max_s)
+    k_a = min(2 * GATHER_K0, max_a)
+    k_b = min(GATHER_K0, max_b)
+    while True:
+        exact, n_s, n_a, n_b, payload = fetch(k_s, k_a, k_b)
+        if not exact:
+            return None
+        if n_s <= k_s and n_a <= k_a and n_b <= k_b:
+            return payload
+        k_s = escalate_cap(n_s, k_s, max_s)
+        k_a = escalate_cap(n_a, k_a, max_a)
+        k_b = escalate_cap(n_b, k_b, max_b)
+
+
 def pinned_duration(trace_id: int, bank, existing=None):
     """TraceIdDuration over a pinned trace's banked spans, widened by
     any ring result (partial eviction leaves the ring narrower)."""

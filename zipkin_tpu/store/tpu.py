@@ -646,30 +646,18 @@ class TpuSpanStore(SpanStore):
         """Whole-trace gather through the trace-membership buckets (see
         dev.iquery_gather_trace_rows). Returns the gather payload, or
         None when any queried bucket fails its exactness gate — the
-        caller then runs the full-ring scan gather. Candidate volume is
-        bounded by nq x per-family depth, so one cap escalation covers
-        everything the buckets can hold."""
-        from zipkin_tpu.store.base import GATHER_K0, escalate_cap
+        caller then runs the full-ring scan gather."""
+        from zipkin_tpu.store.base import index_gather_with_escalation
 
-        c = self.config
-        max_s = min(len(qids) * c.TRACE_SPAN_DEPTH, c.capacity)
-        max_a = min(len(qids) * c.TRACE_ANN_DEPTH, c.ann_capacity)
-        max_b = min(len(qids) * c.TRACE_BANN_DEPTH, c.bann_capacity)
-        k_s = min(GATHER_K0, max_s)
-        k_a = min(2 * GATHER_K0, max_a)
-        k_b = min(GATHER_K0, max_b)
-        while True:
+        def fetch(k_s, k_a, k_b):
             counts, s_m, a_m, b_m, exact = jax.device_get(
                 dev.iquery_gather_trace_rows(st, qids, k_s, k_a, k_b)
             )
-            if not exact:
-                return None
             n_s, n_a, n_b = (int(x) for x in counts)
-            if n_s <= k_s and n_a <= k_a and n_b <= k_b:
-                return n_s, n_a, n_b, s_m, a_m, b_m
-            k_s = escalate_cap(n_s, k_s, max_s)
-            k_a = escalate_cap(n_a, k_a, max_a)
-            k_b = escalate_cap(n_b, k_b, max_b)
+            return (bool(exact), n_s, n_a, n_b,
+                    (n_s, n_a, n_b, s_m, a_m, b_m))
+
+        return index_gather_with_escalation(self.config, len(qids), fetch)
 
     def get_traces_duration(
         self, trace_ids: Sequence[int]
