@@ -30,7 +30,7 @@ _STATE_FILE = "state.npz"
 _META_FILE = "meta.json"
 _PINS_FILE = "pins.pkl"
 # Bump when the StoreState schema changes in a way load() must adapt to.
-_REVISION = 5
+_REVISION = 6
 
 
 def _dict_dump(d) -> list:
@@ -223,11 +223,12 @@ def load(path: str, mesh=None):
     known = set(dev.StoreState._FIELDS)
     revision = meta.get("revision", 1)
     legacy = revision < 4
-    # Snapshots predating (parts of) the index families would restore
-    # empty buckets whose zero cursors claim completeness — hiding
-    # every restored span from the fast paths. Poison index trust so
-    # the exact scan kernels serve instead (load() applies below).
-    pre_index = revision < 5
+    # Snapshots predating (parts of) the index families — or carrying
+    # the pre-unification per-family layout — would restore empty
+    # buckets whose zero cursors claim completeness, hiding every
+    # restored span from the fast paths. Poison index trust so the
+    # exact scan kernels serve instead (load() applies below).
+    pre_index = revision < 6
     upd = {k: v for k, v in upd.items() if k in known}
     if legacy:
         _migrate_legacy_live_links(data, upd, config, n_shards)

@@ -391,21 +391,23 @@ class ShardedSpanStore:
         def build():
             def fn(state, svc, name_lc, end_ts):
                 st = self._unstack(state)
+                lay, _, _ = c.cand_layout
                 if named:
+                    fam = lay[dev.StoreConfig.CAND_NAME]
                     mat, complete, wm = dev._iq_verify_impl(
-                        st.name_idx, st.name_idx_pos, st.name_idx_wm,
+                        st.cand_idx, st.cand_pos, st.cand_wm,
                         st.row_gid, st.indexable, st.trace_id, st.ts_last,
-                        c.capacity, c.name_buckets, c.name_depth,
-                        min(limit, c.name_depth),
+                        c.capacity, fam, min(limit, fam[3]),
                         (svc.astype(jnp.int32), name_lc.astype(jnp.int32)),
                         end_ts,
                     )
                 else:
+                    fam = lay[dev.StoreConfig.CAND_SVC]
                     mat, complete, wm = dev._iq_service_impl(
-                        st.svc_idx, st.svc_idx_pos, st.svc_idx_wm,
+                        st.cand_idx, st.cand_pos, st.cand_wm,
                         st.row_gid, st.indexable, st.trace_id,
-                        st.ts_last, c.capacity, c.svc_depth,
-                        min(limit, c.svc_depth), svc, end_ts,
+                        st.ts_last, c.capacity, fam,
+                        min(limit, fam[3]), svc, end_ts,
                     )
                 return mat[None], complete[None], wm[None]
 
@@ -425,30 +427,31 @@ class ShardedSpanStore:
         def build():
             def fn(state, svc, ann, bkey, bval, bval2, end_ts):
                 st = self._unstack(state)
+                lay, _, _ = c.cand_layout
                 svc32 = svc.astype(jnp.int32)
                 if mode == "ann":
+                    fam = lay[dev.StoreConfig.CAND_ANN]
                     mat, complete, wm = dev._iq_verify_impl(
-                        st.ann_idx, st.ann_idx_pos, st.ann_idx_wm,
+                        st.cand_idx, st.cand_pos, st.cand_wm,
                         st.row_gid, st.indexable, st.trace_id, st.ts_last,
-                        c.capacity, c.ann_buckets, c.ann_depth,
-                        min(limit, c.ann_depth),
+                        c.capacity, fam, min(limit, fam[3]),
                         (svc32, ann.astype(jnp.int32)), end_ts,
                     )
                 elif mode == "bkey":
+                    fam = lay[dev.StoreConfig.CAND_BANN]
                     mat, complete, wm = dev._iq_verify_impl(
-                        st.bann_idx, st.bann_idx_pos, st.bann_idx_wm,
+                        st.cand_idx, st.cand_pos, st.cand_wm,
                         st.row_gid, st.indexable, st.trace_id, st.ts_last,
-                        c.capacity, c.bann_buckets, c.bann_depth,
-                        min(limit, c.bann_depth),
+                        c.capacity, fam, min(limit, fam[3]),
                         (svc32, bkey.astype(jnp.int32), jnp.int32(-1)),
                         end_ts,
                     )
                 else:
+                    fam = lay[dev.StoreConfig.CAND_BANN]
                     mat, complete, wm = dev._iq_verify2_impl(
-                        st.bann_idx, st.bann_idx_pos, st.bann_idx_wm,
+                        st.cand_idx, st.cand_pos, st.cand_wm,
                         st.row_gid, st.indexable, st.trace_id, st.ts_last,
-                        c.capacity, c.bann_buckets, c.bann_depth,
-                        min(limit, c.bann_depth),
+                        c.capacity, fam, min(limit, fam[3]),
                         (svc32, bkey.astype(jnp.int32),
                          bval.astype(jnp.int32)),
                         (svc32, bkey.astype(jnp.int32),

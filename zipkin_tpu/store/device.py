@@ -167,12 +167,53 @@ class StoreConfig(NamedTuple):
             or max(256, 2 * self.capacity // self.TRACE_SPAN_DEPTH)
         )
 
+    # -- unified index layouts -------------------------------------------
+    # All candidate families live in ONE flat entry array (and one
+    # cursor/watermark array), written by ONE combined scatter per
+    # ingest step: per-family writes cost ~33 fused kernels each on a
+    # backend where per-kernel overhead dominates (NOTES_r03.md §3).
+    # Layout per family: (bucket_base, slot_base, n_buckets, depth).
+
+    @property
+    def cand_layout(self):
+        return _pack_layout((
+            (self.max_services, self.svc_depth),
+            (self.name_buckets, self.name_depth),
+            (self.ann_buckets, self.ann_depth),
+            (self.bann_buckets, self.bann_depth),
+        ))
+
+    CAND_SVC, CAND_NAME, CAND_ANN, CAND_BANN = range(4)
+
+    @property
+    def trace_layout(self):
+        B = self.trace_buckets
+        return _pack_layout((
+            (B, self.TRACE_SPAN_DEPTH), (B, self.TRACE_ANN_DEPTH),
+            (B, self.TRACE_BANN_DEPTH),
+        ))
+
+    TR_SPAN, TR_ANN, TR_BANN = range(3)
+
 
 def _next_pow2_int(n: int) -> int:
     p = 1
     while p < n:
         p <<= 1
     return p
+
+
+def _pack_layout(fams):
+    """((n_buckets, depth), ...) → (per-family (bucket_base, slot_base,
+    n_buckets, depth), total_buckets, total_slots) — the shared packing
+    of the unified index arrays."""
+    out = []
+    b_base = s_base = 0
+    for n_b, depth in fams:
+        out.append((b_base, s_base, n_b, depth))
+        b_base += n_b
+        s_base += n_b * depth
+    return tuple(out), b_base, s_base
 
 
 def _ring(n, dtype, fill=0):
@@ -253,38 +294,26 @@ class StoreState:
     pend_pos: jnp.ndarray  # scalar i64 — pending ring cursor
 
     # -- index column families -------------------------------------------
-    # Flat [B*K, 3] i64 entry arrays (gid, verify, ts) + [B] i32 cursors
-    # + [B] i64 overwrite watermarks. Bucket b's FIFO ring is rows
-    # [b*K, (b+1)*K); cursor <= K means the bucket never wrapped (it
-    # holds EVERY entry ever written for its key → an index read is
-    # complete); a wrapped bucket is still exact when the query's last
-    # candidate ranks >= the watermark (see _index_write).
-    svc_idx: jnp.ndarray
-    svc_idx_pos: jnp.ndarray
-    svc_idx_wm: jnp.ndarray
-    name_idx: jnp.ndarray
-    name_idx_pos: jnp.ndarray
-    name_idx_wm: jnp.ndarray
-    ann_idx: jnp.ndarray
-    ann_idx_pos: jnp.ndarray
-    ann_idx_wm: jnp.ndarray
-    bann_idx: jnp.ndarray
-    bann_idx_pos: jnp.ndarray
-    bann_idx_wm: jnp.ndarray
-    # Trace-membership family: [B*K] i64 row gids bucketed by trace-id
-    # hash, one sub-family per ring; wm = max DISPLACED gid. A bucket
-    # provably holds every RESIDENT row of its traces when everything
-    # it ever displaced is already evicted (wm < write_pos - capacity)
-    # — the exactness gate for whole-trace fetch and durations.
-    tr_span_idx: jnp.ndarray
-    tr_span_pos: jnp.ndarray
-    tr_span_wm: jnp.ndarray
-    tr_ann_idx: jnp.ndarray
-    tr_ann_pos: jnp.ndarray
-    tr_ann_wm: jnp.ndarray
-    tr_bann_idx: jnp.ndarray
-    tr_bann_pos: jnp.ndarray
-    tr_bann_wm: jnp.ndarray
+    # Candidate families (service / service+name / service+ann-value /
+    # service+binary) share ONE flat [total_slots, 3] i64 entry array
+    # (gid, verify, ts), one [total_buckets] i64 cursor array, and one
+    # watermark array, laid out per StoreConfig.cand_layout. A bucket's
+    # FIFO ring never wrapping (cursor <= depth) means it holds EVERY
+    # entry ever written for its key → an index read is complete; a
+    # wrapped bucket is still exact when the query's last candidate
+    # ranks >= the watermark (see _index_write).
+    cand_idx: jnp.ndarray
+    cand_pos: jnp.ndarray
+    cand_wm: jnp.ndarray
+    # Trace-membership family: [total_slots] i64 row gids bucketed by
+    # trace-id hash, one sub-family per ring (StoreConfig.trace_layout);
+    # wm = max DISPLACED gid. A bucket provably holds every RESIDENT
+    # row of its traces when everything it ever displaced is already
+    # evicted (wm < write_pos - capacity) — the exactness gate for
+    # whole-trace fetch and durations.
+    tr_idx: jnp.ndarray
+    tr_pos: jnp.ndarray
+    tr_wm: jnp.ndarray
     svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
     svc_span_counts: jnp.ndarray  # [S] f32
     ann_svc_counts: jnp.ndarray  # [S] f32 — services seen on any annotation
@@ -308,13 +337,7 @@ class StoreState:
         "dep_moments", "dep_banks", "dep_bank_ts", "dep_overflow_ts",
         "dep_bank_seq", "dep_window", "dep_window_ts", "span_tab",
         "pend_key", "pend_dur", "pend_tsf", "pend_tsl", "pend_pos",
-        "svc_idx", "svc_idx_pos", "svc_idx_wm",
-        "name_idx", "name_idx_pos", "name_idx_wm",
-        "ann_idx", "ann_idx_pos", "ann_idx_wm",
-        "bann_idx", "bann_idx_pos", "bann_idx_wm",
-        "tr_span_idx", "tr_span_pos", "tr_span_wm",
-        "tr_ann_idx", "tr_ann_pos", "tr_ann_wm",
-        "tr_bann_idx", "tr_bann_pos", "tr_bann_wm",
+        "cand_idx", "cand_pos", "cand_wm", "tr_idx", "tr_pos", "tr_wm",
         "svc_hist", "svc_span_counts", "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
         "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
@@ -385,32 +408,12 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         pend_tsf=jnp.zeros(c.pending_slots, jnp.int64),
         pend_tsl=jnp.zeros(c.pending_slots, jnp.int64),
         pend_pos=jnp.int64(0),
-        svc_idx=jnp.full((S * c.svc_depth, 3), -1, jnp.int64),
-        svc_idx_pos=jnp.zeros(S, jnp.int64),
-        svc_idx_wm=jnp.full(S, I64_MIN, jnp.int64),
-        name_idx=jnp.full((c.name_buckets * c.name_depth, 3), -1,
-                          jnp.int64),
-        name_idx_pos=jnp.zeros(c.name_buckets, jnp.int64),
-        name_idx_wm=jnp.full(c.name_buckets, I64_MIN, jnp.int64),
-        ann_idx=jnp.full((c.ann_buckets * c.ann_depth, 3), -1, jnp.int64),
-        ann_idx_pos=jnp.zeros(c.ann_buckets, jnp.int64),
-        ann_idx_wm=jnp.full(c.ann_buckets, I64_MIN, jnp.int64),
-        bann_idx=jnp.full((c.bann_buckets * c.bann_depth, 3), -1,
-                          jnp.int64),
-        bann_idx_pos=jnp.zeros(c.bann_buckets, jnp.int64),
-        bann_idx_wm=jnp.full(c.bann_buckets, I64_MIN, jnp.int64),
-        tr_span_idx=jnp.full(c.trace_buckets * c.TRACE_SPAN_DEPTH, -1,
-                             jnp.int64),
-        tr_span_pos=jnp.zeros(c.trace_buckets, jnp.int64),
-        tr_span_wm=jnp.full(c.trace_buckets, I64_MIN, jnp.int64),
-        tr_ann_idx=jnp.full(c.trace_buckets * c.TRACE_ANN_DEPTH, -1,
-                            jnp.int64),
-        tr_ann_pos=jnp.zeros(c.trace_buckets, jnp.int64),
-        tr_ann_wm=jnp.full(c.trace_buckets, I64_MIN, jnp.int64),
-        tr_bann_idx=jnp.full(c.trace_buckets * c.TRACE_BANN_DEPTH, -1,
-                             jnp.int64),
-        tr_bann_pos=jnp.zeros(c.trace_buckets, jnp.int64),
-        tr_bann_wm=jnp.full(c.trace_buckets, I64_MIN, jnp.int64),
+        cand_idx=jnp.full((c.cand_layout[2], 3), -1, jnp.int64),
+        cand_pos=jnp.zeros(c.cand_layout[1], jnp.int64),
+        cand_wm=jnp.full(c.cand_layout[1], I64_MIN, jnp.int64),
+        tr_idx=jnp.full(c.trace_layout[2], -1, jnp.int64),
+        tr_pos=jnp.zeros(c.trace_layout[1], jnp.int64),
+        tr_wm=jnp.full(c.trace_layout[1], I64_MIN, jnp.int64),
         svc_hist=Q.init(
             shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
             dtype=jnp.int32,
@@ -705,9 +708,11 @@ def _fifo_ranks(bucket, valid):
     + a cummax segment-start fill — deterministic, so two ingests of the
     same batch produce bitwise-identical index state."""
     n = bucket.shape[0]
-    assert n < (1 << 20), "index write exceeds rank key space"
-    key = jnp.where(valid, bucket.astype(jnp.int64), jnp.int64(1) << 42)
-    skey = (key << 20) | jnp.arange(n, dtype=jnp.int64)
+    assert n < (1 << 21), "index write exceeds rank key space"
+    # Sentinel must survive the << 21 without wrapping sign: 2^41 keys
+    # after every real bucket id (buckets < 2^21), 2^62 after shifting.
+    key = jnp.where(valid, bucket.astype(jnp.int64), jnp.int64(1) << 41)
+    skey = (key << 21) | jnp.arange(n, dtype=jnp.int64)
     order = jnp.argsort(skey)
     sk = key[order]
     first = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
@@ -716,24 +721,29 @@ def _fifo_ranks(bucket, valid):
     return jnp.zeros(n, jnp.int32).at[order].set(idxs - start)
 
 
-def _index_write(entries, pos, wm, bucket, gid, verify, ts, valid,
-                 depth: int):
-    """Append (gid, verify, ts) rows to their buckets' FIFO rings.
+def _index_write(entries, pos, wm, gbucket, slot0, depth, gid, verify,
+                 ts, valid):
+    """ONE combined append of (gid, verify, ts) rows into the unified
+    candidate-family entry array: ``gbucket`` is the global bucket id
+    (addressing pos/wm), ``slot0`` the bucket's first entry row, and
+    ``depth`` its FIFO depth — all per-row vectors, constant per
+    concatenated family segment, so every family rides the same sort,
+    scatter, and cursor update (per-kernel overhead dominates on this
+    backend, NOTES_r03.md §3).
 
     ``wm`` is the per-bucket overwrite watermark: the max ts ever
-    displaced from the ring (by wraparound, or by in-batch overflow
-    where one launch writes more than ``depth`` rows to a bucket and
-    keeps the newest). Queries on a wrapped bucket are exact iff their
-    last returned candidate still ranks >= the watermark — every span
-    the index no longer holds ranks at or below it."""
+    displaced (by wraparound, or by in-batch overflow where one launch
+    writes more than ``depth`` rows to a bucket and keeps the newest).
+    Queries on a wrapped bucket are exact iff their last returned
+    candidate still ranks >= the watermark."""
     n_b = pos.shape[0]
-    rank = _fifo_ranks(bucket, valid)
-    b_c = jnp.clip(bucket, 0, n_b - 1)
+    rank = _fifo_ranks(gbucket, valid)
+    b_c = jnp.clip(gbucket, 0, n_b - 1)
     oob_b = jnp.where(valid, b_c, n_b)
     cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
         1, mode="drop")[:n_b]
     keep = valid & (rank >= cnt[b_c] - depth)
-    slot = b_c * depth + ((pos[b_c] + rank) % depth)
+    slot = slot0 + ((pos[b_c] + rank) % depth)
     idx = jnp.where(keep, slot, entries.shape[0])
     old = entries[jnp.clip(idx, 0, entries.shape[0] - 1)]
     old_ts = jnp.where(keep & (old[:, 0] >= 0), old[:, 2], I64_MIN)
@@ -750,24 +760,24 @@ def _index_write(entries, pos, wm, bucket, gid, verify, ts, valid,
     return entries, pos, wm
 
 
-def _gid_index_write(entries, pos, wm, bucket, gid, valid, depth: int):
-    """Append row gids to per-bucket FIFO rings; ``wm`` tracks the max
-    gid ever displaced. Ring overwrite order is oldest-first, so once
-    wm < (ring write_pos - ring capacity), everything a bucket lost is
-    already evicted and the bucket provably holds every RESIDENT row of
-    its traces — the query-time exactness gate. Sizing buckets*depth >=
-    2x the ring keeps the gate true in steady state (a displaced entry
-    is ~2 retention windows old); only a single trace hotter than
-    ``depth`` rows per family keeps its own gate false forever, which
-    the scan fallback covers."""
+def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
+    """Combined gid-only variant for the trace-membership sub-families;
+    ``wm`` tracks the max gid ever displaced. Ring overwrite order is
+    oldest-first, so once wm < (ring write_pos - ring capacity),
+    everything a bucket lost is already evicted and the bucket provably
+    holds every RESIDENT row of its traces — the query-time exactness
+    gate. Sizing buckets*depth >= 2x the ring keeps the gate true in
+    steady state; only a single trace hotter than ``depth`` rows per
+    family keeps its own gate false forever, which the scan fallback
+    covers."""
     n_b = pos.shape[0]
-    rank = _fifo_ranks(bucket, valid)
-    b_c = jnp.clip(bucket, 0, n_b - 1)
+    rank = _fifo_ranks(gbucket, valid)
+    b_c = jnp.clip(gbucket, 0, n_b - 1)
     oob_b = jnp.where(valid, b_c, n_b)
     cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
         1, mode="drop")[:n_b]
     keep = valid & (rank >= cnt[b_c] - depth)
-    slot = b_c * depth + ((pos[b_c] + rank) % depth)
+    slot = slot0 + ((pos[b_c] + rank) % depth)
     idx = jnp.where(keep, slot, entries.shape[0])
     old = entries[jnp.clip(idx, 0, entries.shape[0] - 1)]
     old_gid = jnp.where(keep & (old >= 0), old, I64_MIN)
@@ -949,11 +959,10 @@ def poison_index_trust(state: "StoreState") -> "StoreState":
     the snapshot was taken under."""
     big = jnp.int64(1) << 60
     upd = {}
-    for fam in ("svc_idx", "name_idx", "ann_idx", "bann_idx",
-                "tr_span", "tr_ann", "tr_bann"):
+    for fam in ("cand", "tr"):
         pos = getattr(state, f"{fam}_pos")
         wm = getattr(state, f"{fam}_wm")
-        # Explicit i64 (a legacy snapshot may restore i32 cursors).
+        # Explicit i64 (a legacy snapshot may restore other dtypes).
         upd[f"{fam}_pos"] = jnp.full(pos.shape, big, jnp.int64)
         upd[f"{fam}_wm"] = jnp.full(wm.shape, I64_MAX, jnp.int64)
     return state.replace(**upd)
@@ -1126,28 +1135,42 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     # (written before the counter block; the ann-derived columns below
     # are shared with the presence/top-annotation updates further down)
     if c.use_index:
+        lay, _, _ = c.cand_layout
         a_host = b.ann_service_id
         a_idx_ok = mask_a & (a_host >= 0) & (a_host < S)
         gid_a = jnp.where(a_idx_ok, span_gid_of_ann, -1)
         ts_a = b.ts_last[b.ann_span_idx]
+
+        def seg(fam, local_bucket, gid, verify, ts, ok):
+            """One concatenation segment of the combined write: global
+            bucket, first-slot row, depth vectors + the entry payload."""
+            b_base, s_base, n_b, depth = lay[fam]
+            lb = jnp.clip(local_bucket, 0, n_b - 1)
+            n = lb.shape[0]
+            return (
+                lb.astype(jnp.int32) + jnp.int32(b_base),
+                lb.astype(jnp.int64) * depth + jnp.int64(s_base),
+                jnp.full(n, depth, jnp.int32),
+                jnp.asarray(gid, jnp.int64),
+                jnp.asarray(verify, jnp.int64),
+                jnp.asarray(ts, jnp.int64),
+                ok,
+            )
+
+        segments = []
         # Service family: bucket = the annotation's own host service —
         # exactly the rows the scan kernel matches for a service query.
-        upd["svc_idx"], upd["svc_idx_pos"], upd["svc_idx_wm"] = \
-            _index_write(
-                state.svc_idx, state.svc_idx_pos, state.svc_idx_wm,
-                jnp.clip(a_host, 0, S - 1), gid_a,
-                a_host.astype(jnp.int64), ts_a, a_idx_ok, c.svc_depth,
-            )
+        segments.append(seg(
+            StoreConfig.CAND_SVC, a_host, gid_a, a_host, ts_a, a_idx_ok
+        ))
         # (service, span name) family.
         ann_name_lc_i = b.name_lc_id[b.ann_span_idx]
         nm_ok = a_idx_ok & (ann_name_lc_i >= 0)
         nm_mix = _mixb([a_host, ann_name_lc_i])
-        upd["name_idx"], upd["name_idx_pos"], upd["name_idx_wm"] = \
-            _index_write(
-                state.name_idx, state.name_idx_pos, state.name_idx_wm,
-                _bucket_of(nm_mix, c.name_buckets), gid_a,
-                _verify_of(nm_mix), ts_a, nm_ok, c.name_depth,
-            )
+        segments.append(seg(
+            StoreConfig.CAND_NAME, _bucket_of(nm_mix, c.name_buckets),
+            gid_a, _verify_of(nm_mix), ts_a, nm_ok,
+        ))
         # (service, annotation value) family: a span's value can match a
         # query under ANY of its hosts (per-slot semantics of the scan /
         # the in-memory oracle), so entries are written under the span's
@@ -1160,65 +1183,66 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
             mask_a & (b.ann_value_id >= FIRST_USER_ANNOTATION_ID)
             & (b.ann_value_id < jnp.int32(1 << 30))
         )
-        av_host = jnp.concatenate([h1, h2])
-        av_val = jnp.concatenate([b.ann_value_id, b.ann_value_id])
-        av_gid = jnp.concatenate([span_gid_of_ann, span_gid_of_ann])
-        av_ok = jnp.concatenate([
-            v_ok & (h1 >= 0) & (h1 < S),
-            v_ok & (h2 >= 0) & (h2 < S) & (h2 != h1),
-        ])
-        av_mix = _mixb([av_host, av_val])
-        av_ts = jnp.concatenate([ts_a, ts_a])
-        upd["ann_idx"], upd["ann_idx_pos"], upd["ann_idx_wm"] = \
-            _index_write(
-                state.ann_idx, state.ann_idx_pos, state.ann_idx_wm,
-                _bucket_of(av_mix, c.ann_buckets),
-                jnp.where(av_ok, av_gid, -1),
-                _verify_of(av_mix), av_ts, av_ok, c.ann_depth,
-            )
+        for h, extra in ((h1, None), (h2, h2 != h1)):
+            ok = v_ok & (h >= 0) & (h < S)
+            if extra is not None:
+                ok &= extra
+            mix = _mixb([h, b.ann_value_id])
+            segments.append(seg(
+                StoreConfig.CAND_ANN, _bucket_of(mix, c.ann_buckets),
+                jnp.where(ok, span_gid_of_ann, -1), _verify_of(mix),
+                ts_a, ok,
+            ))
         # (service, binary key[, value]) family: two bucket keyings per
         # host — with the value (valued queries) and with a -1 sentinel
         # (key-only queries) — under the span's host-set pair.
         bh1 = hmin[b.bann_span_idx]
         bh2 = hmax[b.bann_span_idx]
         bk_idx_ok = mask_b & (b.bann_key_id >= 0)
-        bv_host = jnp.concatenate([bh1, bh2, bh1, bh2])
-        bv_key = jnp.tile(b.bann_key_id, 4)
-        bv_val = jnp.concatenate([
-            b.bann_value_id, b.bann_value_id,
-            jnp.full(2 * PB, -1, jnp.int32),
-        ])
-        bv_gid = jnp.tile(span_gid_of_bann, 4)
-        ok1 = bk_idx_ok & (bh1 >= 0) & (bh1 < S)
-        ok2 = bk_idx_ok & (bh2 >= 0) & (bh2 < S) & (bh2 != bh1)
-        bv_ok = jnp.concatenate([ok1, ok2, ok1, ok2])
-        bv_mix = _mixb([bv_host, bv_key, bv_val])
-        bv_ts = jnp.tile(b.ts_last[b.bann_span_idx], 4)
-        upd["bann_idx"], upd["bann_idx_pos"], upd["bann_idx_wm"] = \
-            _index_write(
-                state.bann_idx, state.bann_idx_pos, state.bann_idx_wm,
-                _bucket_of(bv_mix, c.bann_buckets),
-                jnp.where(bv_ok, bv_gid, -1),
-                _verify_of(bv_mix), bv_ts, bv_ok, c.bann_depth,
-            )
+        ts_b = b.ts_last[b.bann_span_idx]
+        no_val = jnp.full(PB, -1, jnp.int32)
+        for h, val, extra in (
+            (bh1, b.bann_value_id, None), (bh2, b.bann_value_id, bh2 != bh1),
+            (bh1, no_val, None), (bh2, no_val, bh2 != bh1),
+        ):
+            ok = bk_idx_ok & (h >= 0) & (h < S)
+            if extra is not None:
+                ok &= extra
+            mix = _mixb([h, b.bann_key_id, val])
+            segments.append(seg(
+                StoreConfig.CAND_BANN, _bucket_of(mix, c.bann_buckets),
+                jnp.where(ok, span_gid_of_bann, -1), _verify_of(mix),
+                ts_b, ok,
+            ))
+        cat = [jnp.concatenate(parts) for parts in zip(*segments)]
+        upd["cand_idx"], upd["cand_pos"], upd["cand_wm"] = _index_write(
+            state.cand_idx, state.cand_pos, state.cand_wm, *cat
+        )
         # Trace-membership family: row gids bucketed by trace-id hash,
         # one sub-family per ring (whole-trace fetch + durations).
+        tlay, _, _ = c.trace_layout
         tb = _bucket_of(_mixb([b.trace_id]), c.trace_buckets)
-        upd["tr_span_idx"], upd["tr_span_pos"], upd["tr_span_wm"] = \
-            _gid_index_write(
-                state.tr_span_idx, state.tr_span_pos, state.tr_span_wm,
-                tb, gids, mask, c.TRACE_SPAN_DEPTH,
+
+        def tseg(fam, local_bucket, gid, ok):
+            b_base, s_base, n_b, depth = tlay[fam]
+            lb = jnp.clip(local_bucket, 0, n_b - 1)
+            return (
+                lb.astype(jnp.int32) + jnp.int32(b_base),
+                lb.astype(jnp.int64) * depth + jnp.int64(s_base),
+                jnp.full(lb.shape[0], depth, jnp.int32),
+                jnp.asarray(gid, jnp.int64),
+                ok,
             )
-        upd["tr_ann_idx"], upd["tr_ann_pos"], upd["tr_ann_wm"] = \
-            _gid_index_write(
-                state.tr_ann_idx, state.tr_ann_pos, state.tr_ann_wm,
-                tb[b.ann_span_idx], a_gids, mask_a, c.TRACE_ANN_DEPTH,
-            )
-        upd["tr_bann_idx"], upd["tr_bann_pos"], upd["tr_bann_wm"] = \
-            _gid_index_write(
-                state.tr_bann_idx, state.tr_bann_pos, state.tr_bann_wm,
-                tb[b.bann_span_idx], bb_gids, mask_b, c.TRACE_BANN_DEPTH,
-            )
+
+        tcat = [jnp.concatenate(parts) for parts in zip(
+            tseg(StoreConfig.TR_SPAN, tb, gids, mask),
+            tseg(StoreConfig.TR_ANN, tb[b.ann_span_idx], a_gids, mask_a),
+            tseg(StoreConfig.TR_BANN, tb[b.bann_span_idx], bb_gids,
+                 mask_b),
+        )]
+        upd["tr_idx"], upd["tr_pos"], upd["tr_wm"] = _gid_index_write(
+            state.tr_idx, state.tr_pos, state.tr_wm, *tcat
+        )
 
     # -- per-service latency histogram ---------------------------------
     hist = svc_histogram(state)
@@ -1475,50 +1499,62 @@ def _iq_finish(entries, cnt, wm, row_gid, indexable, ts_last, trace_id,
 
 @partial(jax.jit, static_argnums=(7, 8, 9))
 def _iq_service_impl(entries, pos, wm, row_gid, indexable, trace_id,
-                     ts_last, capacity: int, depth: int, k: int,
+                     ts_last, capacity: int, layout, k: int,
                      svc, end_ts):
     # Span-name-filtered lookups route through the (service, name)
     # family (_iq_verify_impl), never through this bucket.
-    svc_i = jnp.asarray(svc, jnp.int32)
+    b_base, s_base, n_b, depth = layout
+    svc_i = jnp.clip(jnp.asarray(svc, jnp.int32), 0, n_b - 1)
     row = jax.lax.dynamic_slice(
-        entries, (svc_i * depth, jnp.int32(0)), (depth, 3)
+        entries, (jnp.int32(s_base) + svc_i * depth, jnp.int32(0)),
+        (depth, 3),
     )
-    cnt = pos[svc_i]
+    gb = jnp.int32(b_base) + svc_i
     ok = jnp.ones(depth, bool)
-    return _iq_finish(row, cnt, wm[svc_i], row_gid, indexable, ts_last,
+    return _iq_finish(row, pos[gb], wm[gb], row_gid, indexable, ts_last,
                       trace_id, ok, capacity, depth, k, end_ts)
 
 
-@partial(jax.jit, static_argnums=(7, 8, 9, 10))
+@partial(jax.jit, static_argnums=(7, 8, 9))
 def _iq_verify_impl(entries, pos, wm, row_gid, indexable, trace_id,
-                    ts_last, capacity: int, n_buckets: int, depth: int,
-                    k: int, key_parts, end_ts):
+                    ts_last, capacity: int, layout, k: int,
+                    key_parts, end_ts):
+    b_base, s_base, n_b, depth = layout
     mixed = _mixb(list(key_parts))
-    b = _bucket_of(mixed, n_buckets)
-    row = jax.lax.dynamic_slice(entries, (b * depth, jnp.int32(0)),
-                                (depth, 3))
-    cnt = pos[b]
+    lb = _bucket_of(mixed, n_b)
+    row = jax.lax.dynamic_slice(
+        entries, (jnp.int32(s_base) + lb * depth, jnp.int32(0)),
+        (depth, 3),
+    )
+    gb = jnp.int32(b_base) + lb
     ver_ok = row[:, 1] == _verify_of(mixed)
-    return _iq_finish(row, cnt, wm[b], row_gid, indexable, ts_last,
+    return _iq_finish(row, pos[gb], wm[gb], row_gid, indexable, ts_last,
                       trace_id, ver_ok, capacity, depth, k, end_ts)
 
 
-@partial(jax.jit, static_argnums=(7, 8, 9, 10))
+@partial(jax.jit, static_argnums=(7, 8, 9))
 def _iq_verify2_impl(entries, pos, wm, row_gid, indexable, trace_id,
-                     ts_last, capacity: int, n_buckets: int, depth: int,
-                     k: int, key_parts1, key_parts2, end_ts):
+                     ts_last, capacity: int, layout, k: int,
+                     key_parts1, key_parts2, end_ts):
+    b_base, s_base, n_b, depth = layout
     m1 = _mixb(list(key_parts1))
     m2 = _mixb(list(key_parts2))
-    b1 = _bucket_of(m1, n_buckets)
-    b2 = _bucket_of(m2, n_buckets)
-    r1 = jax.lax.dynamic_slice(entries, (b1 * depth, jnp.int32(0)),
-                               (depth, 3))
-    r2 = jax.lax.dynamic_slice(entries, (b2 * depth, jnp.int32(0)),
-                               (depth, 3))
+    lb1 = _bucket_of(m1, n_b)
+    lb2 = _bucket_of(m2, n_b)
+    r1 = jax.lax.dynamic_slice(
+        entries, (jnp.int32(s_base) + lb1 * depth, jnp.int32(0)),
+        (depth, 3),
+    )
+    r2 = jax.lax.dynamic_slice(
+        entries, (jnp.int32(s_base) + lb2 * depth, jnp.int32(0)),
+        (depth, 3),
+    )
     row = jnp.concatenate([r1, r2])
-    cnt = jnp.maximum(pos[b1], pos[b2])
+    gb1 = jnp.int32(b_base) + lb1
+    gb2 = jnp.int32(b_base) + lb2
+    cnt = jnp.maximum(pos[gb1], pos[gb2])
     ver_ok = (row[:, 1] == _verify_of(m1)) | (row[:, 1] == _verify_of(m2))
-    return _iq_finish(row, cnt, jnp.maximum(wm[b1], wm[b2]), row_gid,
+    return _iq_finish(row, cnt, jnp.maximum(wm[gb1], wm[gb2]), row_gid,
                       indexable, ts_last, trace_id, ver_ok, capacity,
                       depth, k, end_ts)
 
@@ -1531,18 +1567,20 @@ def iquery_trace_ids_by_service(state: StoreState, svc_id, name_lc_id,
     complete, entry_count); the host falls back to the scan kernel when
     the bucket wrapped and the result underfills (store.base gating)."""
     c = state.config
+    lay, _, _ = c.cand_layout
     if name_lc_id is not None and name_lc_id >= 0:
+        fam = lay[StoreConfig.CAND_NAME]
         return _iq_verify_impl(
-            state.name_idx, state.name_idx_pos, state.name_idx_wm,
+            state.cand_idx, state.cand_pos, state.cand_wm,
             state.row_gid, state.indexable, state.trace_id, state.ts_last,
-            c.capacity, c.name_buckets, c.name_depth,
-            min(k, c.name_depth),
+            c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(name_lc_id)), end_ts,
         )
+    fam = lay[StoreConfig.CAND_SVC]
     return _iq_service_impl(
-        state.svc_idx, state.svc_idx_pos, state.svc_idx_wm,
+        state.cand_idx, state.cand_pos, state.cand_wm,
         state.row_gid, state.indexable, state.trace_id, state.ts_last,
-        c.capacity, c.svc_depth, min(k, c.svc_depth), svc_id, end_ts,
+        c.capacity, fam, min(k, fam[3]), svc_id, end_ts,
     )
 
 
@@ -1553,12 +1591,13 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
     """Index fast path for the annotation query (AnnotationsIndex role).
     Same contract as iquery_trace_ids_by_service."""
     c = state.config
+    lay, _, _ = c.cand_layout
     if ann_value_id is not None and ann_value_id >= 0:
+        fam = lay[StoreConfig.CAND_ANN]
         return _iq_verify_impl(
-            state.ann_idx, state.ann_idx_pos, state.ann_idx_wm,
+            state.cand_idx, state.cand_pos, state.cand_wm,
             state.row_gid, state.indexable, state.trace_id, state.ts_last,
-            c.capacity, c.ann_buckets, c.ann_depth,
-            min(k, c.ann_depth),
+            c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(ann_value_id)), end_ts,
         )
     if bann_value_id is None or bann_value_id < 0:
@@ -1571,21 +1610,20 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
         bann_value_id = bann_value_id2
     if bann_value_id >= 0 and bann_value_id2 < 0:
         bann_value_id2 = bann_value_id
+    fam = lay[StoreConfig.CAND_BANN]
     if bann_value_id < 0:
         # Key-only query: the sentinel-keyed buckets.
         return _iq_verify_impl(
-            state.bann_idx, state.bann_idx_pos, state.bann_idx_wm,
+            state.cand_idx, state.cand_pos, state.cand_wm,
             state.row_gid, state.indexable, state.trace_id, state.ts_last,
-            c.capacity, c.bann_buckets, c.bann_depth,
-            min(k, c.bann_depth),
+            c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(bann_key_id), jnp.int32(-1)),
             end_ts,
         )
     return _iq_verify2_impl(
-        state.bann_idx, state.bann_idx_pos, state.bann_idx_wm,
+        state.cand_idx, state.cand_pos, state.cand_wm,
         state.row_gid, state.indexable, state.trace_id, state.ts_last,
-        c.capacity, c.bann_buckets, c.bann_depth,
-        min(k, c.bann_depth),
+        c.capacity, fam, min(k, fam[3]),
         (jnp.int32(svc_id), jnp.int32(bann_key_id),
          jnp.int32(bann_value_id)),
         (jnp.int32(svc_id), jnp.int32(bann_key_id),
@@ -1596,12 +1634,13 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
 
 @partial(jax.jit, static_argnums=(8, 9))
 def _iq_durations_impl(entries, pos, wm, trace_id, row_gid, ts_first,
-                       ts_last, write_pos, capacity: int, depth: int,
+                       ts_last, write_pos, capacity: int, layout,
                        sorted_qids):
+    b_base, s_base, n_b, depth = layout
     nq = sorted_qids.shape[0]
-    B = pos.shape[0]
-    qb = _bucket_of(_mixb([sorted_qids]), B)
-    rows = (qb[:, None] * depth
+    lb = _bucket_of(_mixb([sorted_qids]), n_b)
+    qb = jnp.int32(b_base) + lb
+    rows = (jnp.int32(s_base) + lb[:, None] * depth
             + jnp.arange(depth, dtype=jnp.int32)[None, :])
     gid = entries[rows.reshape(-1)].reshape(nq, depth)
     slot = jnp.clip((gid % capacity).astype(jnp.int32), 0, capacity - 1)
@@ -1629,10 +1668,12 @@ def iquery_durations(state: StoreState, sorted_qids):
     ``exact`` requires every queried bucket to pass the displaced-gid
     gate; the host falls back to the scan kernel otherwise."""
     c = state.config
+    tlay, _, _ = c.trace_layout
     return _iq_durations_impl(
-        state.tr_span_idx, state.tr_span_pos, state.tr_span_wm,
+        state.tr_idx, state.tr_pos, state.tr_wm,
         state.trace_id, state.row_gid, state.ts_first, state.ts_last,
-        state.write_pos, c.capacity, c.TRACE_SPAN_DEPTH, sorted_qids,
+        state.write_pos, c.capacity, tlay[StoreConfig.TR_SPAN],
+        sorted_qids,
     )
 
 
@@ -1643,26 +1684,26 @@ def _iq_gather_impl(
     write_pos, ann_write_pos, bann_write_pos,
     statics,
 ):
-    (capacity, ann_capacity, bann_capacity, KS, KA, KB,
+    (capacity, ann_capacity, bann_capacity, lay_s, lay_a, lay_b,
      k_spans, k_anns, k_banns) = statics
     trace_id = span_cols[0]
     row_gid = span_cols[-1]
     ann_gid = ann_cols[0]
     bann_gid = bann_cols[0]
     nq = sorted_qids.shape[0]
-    B = tr_pos[0].shape[0]
-    qb = _bucket_of(_mixb([sorted_qids]), B)
+    lb = _bucket_of(_mixb([sorted_qids]), lay_s[2])
 
-    def family(entries, pos, wm, depth, ring_wp, ring_cap):
-        rows = (qb[:, None] * depth
+    def family(layout, ring_wp, ring_cap):
+        b_base, s_base, _, depth = layout
+        qb = jnp.int32(b_base) + lb
+        rows = (jnp.int32(s_base) + lb[:, None] * depth
                 + jnp.arange(depth, dtype=jnp.int32)[None, :])
-        gid = entries[rows.reshape(-1)].reshape(nq, depth)
-        gate = (pos[qb] <= depth) | (wm[qb] < ring_wp - ring_cap)
+        gid = tr_entries[rows.reshape(-1)].reshape(nq, depth)
+        gate = (tr_pos[qb] <= depth) | (tr_wm[qb] < ring_wp - ring_cap)
         return gid, gate.all()
 
     # Span rows: direct liveness + trace match.
-    s_gid, gate_s = family(tr_entries[0], tr_pos[0], tr_wm[0], KS,
-                           write_pos, capacity)
+    s_gid, gate_s = family(lay_s, write_pos, capacity)
     s_slot = jnp.clip((s_gid % capacity).astype(jnp.int32), 0,
                       capacity - 1)
     s_ok = ((s_gid >= 0) & (row_gid[s_slot] == s_gid)
@@ -1674,12 +1715,11 @@ def _iq_gather_impl(
     span_mat = jnp.stack([c[sslot].astype(jnp.int64) for c in span_cols])
     span_mat = jnp.where((vals_s >= 0)[None, :], span_mat, -1)
 
-    def ragged(entries, pos, wm, depth, ring_wp, ring_cap, owner_col,
-               cols, k):
+    def ragged(layout, ring_wp, ring_cap, owner_col, cols, k):
         """Annotation/binary rows: entry validity = the ring slot still
         holds this position (overwrite order) + owning span live and in
         the queried set."""
-        gid, gate = family(entries, pos, wm, depth, ring_wp, ring_cap)
+        gid, gate = family(layout, ring_wp, ring_cap)
         slot = jnp.clip((gid % ring_cap).astype(jnp.int32), 0,
                         ring_cap - 1)
         fresh = (gid >= 0) & (gid >= ring_wp - ring_cap)
@@ -1696,12 +1736,11 @@ def _iq_gather_impl(
         return count, jnp.where((vals >= 0)[None, :], mat, -1), gate
 
     count_a, ann_mat, gate_a = ragged(
-        tr_entries[1], tr_pos[1], tr_wm[1], KA, ann_write_pos,
-        ann_capacity, ann_gid, ann_cols, k_anns,
+        lay_a, ann_write_pos, ann_capacity, ann_gid, ann_cols, k_anns,
     )
     count_b, bann_mat, gate_b = ragged(
-        tr_entries[2], tr_pos[2], tr_wm[2], KB, bann_write_pos,
-        bann_capacity, bann_gid, bann_cols, k_banns,
+        lay_b, bann_write_pos, bann_capacity, bann_gid, bann_cols,
+        k_banns,
     )
     counts = jnp.stack([count_s, count_a, count_b])
     return counts, span_mat, ann_mat, bann_mat, gate_s & gate_a & gate_b
@@ -1718,13 +1757,12 @@ def iquery_gather_trace_rows(
     any queried bucket fails the displaced-gid gate (hot traces beyond
     the per-family depths, or shuffled arrival near the gate)."""
     c = state.config
+    tlay, _, _ = c.trace_layout
     statics = (c.capacity, c.ann_capacity, c.bann_capacity,
-               c.TRACE_SPAN_DEPTH, c.TRACE_ANN_DEPTH,
-               c.TRACE_BANN_DEPTH, k_spans, k_anns, k_banns)
+               tlay[StoreConfig.TR_SPAN], tlay[StoreConfig.TR_ANN],
+               tlay[StoreConfig.TR_BANN], k_spans, k_anns, k_banns)
     return _iq_gather_impl(
-        (state.tr_span_idx, state.tr_ann_idx, state.tr_bann_idx),
-        (state.tr_span_pos, state.tr_ann_pos, state.tr_bann_pos),
-        (state.tr_span_wm, state.tr_ann_wm, state.tr_bann_wm),
+        state.tr_idx, state.tr_pos, state.tr_wm,
         tuple(getattr(state, col) for col in SPAN_MAT_COLS),
         tuple(getattr(state, col) for col in ANN_MAT_COLS),
         tuple(getattr(state, col) for col in BANN_MAT_COLS),
