@@ -7,15 +7,20 @@ padded columnar arrays and **one jitted ``ingest_step`` launch** updates:
 
 - the span/annotation/binary-annotation ring buffers (the store, TTL by
   eviction — the analogue of Cassandra's span TTL, CassieSpanStore:47),
-- the dependency-link Moments bank (streaming ZipkinAggregateJob),
+- the streaming dependency hash join (span table + pending ring +
+  window bank — the ZipkinAggregateJob resolved at ingest time),
+- the device index column families (service / span-name / annotation /
+  binary / trace-membership bucket rings — the Cassandra index CFs),
 - per-service latency histograms (p50/p95/p99 queries),
 - per-service span counts, span-name presence, top-annotation counters
   (ServiceNames/SpanNames/TopAnnotations column families),
 - a HyperLogLog of distinct trace ids and a count-min of spans/trace,
 - ingest counters feeding the adaptive sampler.
 
-Queries are separate jitted kernels over the ring columns (filter → sort
-→ limit on device; the host only receives the k winners).
+Queries are separate jitted kernels: index reads touch O(bucket depth)
+rows and carry exactness gates (never-wrapped cursor, overwrite
+watermark, displaced-gid gate); the O(ring) scan kernels remain the
+always-exact fallback. The host only receives the k winners.
 
 State carries 64-bit ids/timestamps (x64 mode); all sketch state is
 32-bit. Static configuration (capacities) is pytree aux data so jit
