@@ -179,6 +179,46 @@ def test_trace_membership_after_eviction():
         scan.get_traces_duration(survivors)
 
 
+def test_index_first_topk_gating():
+    """The trust policy itself, as a pure function: complete buckets
+    answer unless the top-k window truncated an underfull result;
+    wrapped buckets answer only above their watermark."""
+    from zipkin_tpu.store.base import index_first_topk
+
+    scan_calls = []
+
+    def scan(k):
+        scan_calls.append(k)
+        return [(1, 100), (2, 90)], False
+
+    def run(cands, complete, wm, limit=2):
+        scan_calls.clear()
+        return index_first_topk(
+            limit, 1 << 20, lambda k: (cands, complete, wm),
+            scan,
+        ), bool(scan_calls)
+
+    # Complete + enough distinct traces: index answers.
+    ids, scanned = run([(1, 100), (2, 90)], True, -1)
+    assert [i.trace_id for i in ids] == [1, 2] and not scanned
+    # Complete + underfull + window NOT saturated: the true full answer.
+    ids, scanned = run([(1, 100)], True, -1)
+    assert [i.trace_id for i in ids] == [1] and not scanned
+    # Complete + underfull + saturated window (k = limit*8 = 16
+    # candidates, all one trace): must scan.
+    ids, scanned = run([(1, 100 - i) for i in range(16)], True, -1)
+    assert scanned
+    # Wrapped + full + last candidate above the watermark: trusted.
+    ids, scanned = run([(1, 100), (2, 90)], False, 50)
+    assert [i.trace_id for i in ids] == [1, 2] and not scanned
+    # Wrapped + full + last candidate below the watermark: must scan.
+    ids, scanned = run([(1, 100), (2, 90)], False, 95)
+    assert scanned
+    # Wrapped + underfull: must scan.
+    ids, scanned = run([(1, 100)], False, -1)
+    assert scanned
+
+
 def test_duplicate_trace_ids_in_request():
     """Duplicated request ids must not duplicate spans or wedge the
     index fast path's cap escalation (qids are uniqued; reconstruction
