@@ -161,13 +161,12 @@ def _make_template(store, n_services: int, batch_traces: int):
     )
     db0 = jax.device_put(db0)
 
-    @partial(jax.jit, donate_argnums=(0, 2))
-    def fused_step(state, db, step):
+    def restamp(db, step):
         """Restamp the template ON DEVICE (salt/delta derived from a
         device-carried step counter — a host scalar per step would pay a
-        tunnel round trip each) and run the fused ingest. XOR keeps
-        span_id = trace_id ^ node and the parent join structure intact;
-        time advances one minute per batch.
+        tunnel round trip each). XOR keeps span_id = trace_id ^ node and
+        the parent join structure intact; time advances one minute per
+        batch.
 
         The salt is splitmix64(step): a multiplicative salt correlates
         with the golden-multiplied template trace ids and produces
@@ -183,7 +182,7 @@ def _make_template(store, n_services: int, batch_traces: int):
         def shift(ts):
             return jnp.where(ts >= 0, ts + delta, ts)
 
-        d = db._replace(
+        return db._replace(
             trace_id=db.trace_id ^ salt,
             span_id=db.span_id ^ salt,
             parent_id=jnp.where(db.has_parent, db.parent_id ^ salt,
@@ -193,9 +192,24 @@ def _make_template(store, n_services: int, batch_traces: int):
             ts_first=shift(db.ts_first), ts_last=shift(db.ts_last),
             ann_ts=shift(db.ann_ts),
         )
-        return dev.ingest_step.__wrapped__(state, d), step + 1
 
-    return db0, fused_step, pad_spans
+    @partial(jax.jit, donate_argnums=(0, 2), static_argnums=(3,))
+    def fused_chain(state, db, step, k):
+        """k restamp+ingest steps per LAUNCH via lax.scan: one ~100ms
+        dispatch amortizes over k batches (~5-7ms per scan iteration,
+        NOTES_r03 §3) instead of being paid per batch — the dispatch-
+        floor attack VERDICT r3 item 3 asked for."""
+        def body(carry, _):
+            st, stp = carry
+            st = dev.ingest_step.__wrapped__(st, restamp(db, stp))
+            return (st, stp + 1), None
+
+        (state, step), _ = jax.lax.scan(
+            body, (state, step), None, length=k
+        )
+        return state, step
+
+    return db0, fused_chain, pad_spans
 
 
 def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
@@ -211,9 +225,15 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
 
     config = _tpu_config(capacity_log2, n_services, use_pallas)
     store = TpuSpanStore(config)
-    db0, fused_step, pad_spans = _make_template(
+    db0, fused_chain, pad_spans = _make_template(
         store, n_services, batch_traces
     )
+    cap = config.capacity
+    # Chain length: as many batches per launch as fit HALF the ring
+    # (the archive cadence closes a dependency bucket once per half
+    # capacity, and a single launch must not outrun it), capped at 32.
+    chain = max(1, min(32, (cap // 2) // pad_spans))
+    spans_per_call = chain * pad_spans
 
     def sync(x):
         # A real barrier: device_get forces the D2H round trip.
@@ -224,9 +244,9 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
 
     # Warm the compile caches on a throwaway state (donated away).
     _log(f"stream: compiling (capacity 2^{capacity_log2}, "
-         f"{n_services} services, pallas={use_pallas})")
+         f"{n_services} services, chain {chain}, pallas={use_pallas})")
     wstate = dev.init_state(config)
-    wstate, wstep = fused_step(wstate, db0, jnp.int64(0))
+    wstate, wstep = fused_chain(wstate, db0, jnp.int64(0), chain)
     sync(wstate.counters["spans_seen"])
     _log("stream: ingest compiled")
     wstate = dev.dep_archive_auto(wstate, pad_spans)
@@ -234,52 +254,47 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
     _log("stream: archive compiled")
     del wstate, wstep
 
-    cap = config.capacity
     state = store.state
     step = jnp.int64(0)
     wp = archived = 0
-    n_steps = max(1, total_spans // pad_spans)
+    n_calls = max(1, total_spans // spans_per_call)
     archive_runs = 0
     t0 = time.perf_counter()
-    for i in range(n_steps):
-        # Production archive policy (TpuSpanStore._maybe_archive). The
-        # python-int arg matches the warmup call's aval exactly — a
-        # jnp.int64 here would be a different aval and recompile the
-        # archive join mid-loop.
-        if wp + pad_spans - archived > cap:
+    for i in range(n_calls):
+        # Production archive policy (TpuSpanStore._maybe_archive), at
+        # launch granularity: one chained launch ingests spans_per_call
+        # spans (<= cap/2 by construction).
+        if wp + spans_per_call - archived > cap:
             state = dev.dep_archive_auto(state, pad_spans)
-            archived = min(wp, max(wp + pad_spans - cap, wp - cap // 2))
+            archived = min(
+                wp, max(wp + spans_per_call - cap, wp - cap // 2)
+            )
             archive_runs += 1
-        state, step = fused_step(state, db0, step)
-        wp += pad_spans
-        if (i + 1) % 64 == 0:
-            # True barrier every 64 steps: bounds the async queue depth
-            # and keeps the measured rate honest (one D2H per ~7M spans
-            # amortizes to noise).
+        state, step = fused_chain(state, db0, step, chain)
+        wp += spans_per_call
+        if (i + 1) % 8 == 0:
+            # True barrier every 8 launches: bounds the async queue
+            # depth and keeps the measured rate honest.
             sync(state.counters["spans_seen"])
     seen = sync(state.counters["spans_seen"])
     dt = time.perf_counter() - t0
-    assert seen == n_steps * pad_spans, (seen, n_steps * pad_spans)
-    _log(f"stream: {n_steps * pad_spans} spans in {dt:.1f}s "
-         f"({n_steps * pad_spans / dt / 1e6:.1f}M spans/s, "
-         f"{archive_runs} archive passes)")
+    total = n_calls * spans_per_call
+    assert seen == total, (seen, total)
+    _log(f"stream: {total} spans in {dt:.1f}s "
+         f"({total / dt / 1e6:.1f}M spans/s, "
+         f"{archive_runs} archive passes, chain {chain})")
 
     # Hand the streamed state to the store so the public query API
-    # (device kernels + host decode) serves the read benchmarks. The
-    # stream bypassed _write_device, so mark the sweep clock dirty: the
-    # first dependency read must run a pending sweep (streaming-join
-    # contract) even though no store-mediated batch was written.
-    store.state = state
-    store._wp = wp
-    store._archived = archived
-    store._batches_since_sweep = 1
+    # (device kernels + host decode) serves the read benchmarks.
+    store.adopt_state(state, spans_written=wp, archived=archived)
     stats = {
-        "spans": n_steps * pad_spans,
-        "spans_per_s": round(n_steps * pad_spans / dt, 1),
+        "spans": total,
+        "spans_per_s": round(total / dt, 1),
         "wall_s": round(dt, 2),
         "ring_capacity": cap,
         "services": n_services,
         "batch_spans": pad_spans,
+        "chain": chain,
         "archive_runs": archive_runs,
         "use_pallas": use_pallas,
     }
@@ -375,6 +390,180 @@ def bench_tpu_queries(store, reps: int = 12):
     return out
 
 
+def bench_exactness(store, n_queries: int = 24):
+    """On-device index-vs-scan exactness (VERDICT r3 item 7): the same
+    live store answers each sampled query through the index fast path
+    AND with force_scan pinned; results must match id-for-id whenever
+    the index claimed trust (when it degraded, both paths ran the same
+    scan — trivially equal, still asserted)."""
+    state = store.state
+    end_ts = int(state.ts_max) + 1
+    S = store.config.max_services
+    rng = np.random.default_rng(11)
+    svcs = [f"svc-{i:04d}" for i in rng.integers(0, S, size=n_queries)]
+    checked = mismatches = 0
+    detail = []
+
+    def cmp(tag, fast, slow):
+        nonlocal checked, mismatches
+        checked += 1
+        f = [(i.trace_id, i.timestamp) for i in fast]
+        s = [(i.trace_id, i.timestamp) for i in slow]
+        if f != s:
+            mismatches += 1
+            detail.append({"query": tag, "index": f[:5], "scan": s[:5]})
+
+    for i, svc in enumerate(svcs):
+        cmp(f"service:{svc}",
+            store.get_trace_ids_by_name(svc, None, end_ts, 10),
+            store.get_trace_ids_by_name(svc, None, end_ts, 10,
+                                        force_scan=True))
+        if i % 3 == 0:
+            name = f"op-{i % 2048:04d}"
+            cmp(f"name:{svc}/{name}",
+                store.get_trace_ids_by_name(svc, name, end_ts, 10),
+                store.get_trace_ids_by_name(svc, name, end_ts, 10,
+                                            force_scan=True))
+        if i % 3 == 1:
+            cmp(f"ann:{svc}",
+                store.get_trace_ids_by_annotation(
+                    svc, "some custom annotation", None, end_ts, 10),
+                store.get_trace_ids_by_annotation(
+                    svc, "some custom annotation", None, end_ts, 10,
+                    force_scan=True))
+        if i % 3 == 2:
+            cmp(f"bann:{svc}",
+                store.get_trace_ids_by_annotation(
+                    svc, "http.uri", b"/api/widgets", end_ts, 10),
+                store.get_trace_ids_by_annotation(
+                    svc, "http.uri", b"/api/widgets", end_ts, 10,
+                    force_scan=True))
+    # Trace membership: durations through gid buckets vs full scan.
+    ids = store.get_trace_ids_by_name(svcs[0], None, end_ts, 10)
+    tids = [i.trace_id for i in ids][:10]
+    if tids:
+        checked += 1
+        if (store.get_traces_duration(tids)
+                != store.get_traces_duration(tids, force_scan=True)):
+            mismatches += 1
+            detail.append({"query": "durations"})
+        checked += 1
+        f = store.get_spans_by_trace_ids(tids)
+        s = store.get_spans_by_trace_ids(tids, force_scan=True)
+        if f != s:
+            mismatches += 1
+            detail.append({"query": "get_spans"})
+    out = {"checked": checked, "mismatches": mismatches,
+           "index_hits": store.index_hits,
+           "scan_fallbacks": store.index_fallbacks}
+    if detail:
+        out["mismatch_detail"] = detail[:4]
+    _log(f"exactness: {checked} checks, {mismatches} mismatches, "
+         f"{store.index_hits} index hits / "
+         f"{store.index_fallbacks} fallbacks")
+    return out
+
+
+def bench_checkpoint(store):
+    """Checkpoint at bench scale (VERDICT r3 item 8): snapshot the
+    streamed store, restore it, and require bit-identical answers to a
+    small query set across the save/load boundary."""
+    import shutil
+    import tempfile
+
+    from zipkin_tpu import checkpoint as ckpt
+    from zipkin_tpu.store.tpu import TpuSpanStore
+
+    state = store.state
+    end_ts = int(state.ts_max) + 1
+    S = store.config.max_services
+    svcs = [f"svc-{i:04d}" for i in
+            np.random.default_rng(13).integers(0, S, size=6)]
+
+    def answers(st):
+        out = []
+        for svc in svcs:
+            out.append([(i.trace_id, i.timestamp)
+                        for i in st.get_trace_ids_by_name(
+                            svc, None, end_ts, 10)])
+        deps = st.get_dependencies()
+        out.append(sorted(
+            (l.parent, l.child, l.duration_moments.count)
+            for l in deps.links
+        )[:200])
+        out.append(round(st.estimated_unique_traces(), 1))
+        return out
+
+    before = answers(store)
+    path = tempfile.mkdtemp(prefix="zk_bench_ckpt_")
+    try:
+        t0 = time.perf_counter()
+        ckpt.save(store, path)
+        save_s = time.perf_counter() - t0
+        size_mb = sum(
+            f.stat().st_size for f in __import__("pathlib").Path(path)
+            .rglob("*") if f.is_file()
+        ) / 1e6
+        t0 = time.perf_counter()
+        restored = ckpt.load(path)
+        load_s = time.perf_counter() - t0
+        assert isinstance(restored, TpuSpanStore)
+        after = answers(restored)
+        del restored
+    finally:
+        shutil.rmtree(path, ignore_errors=True)
+    out = {
+        "save_s": round(save_s, 2), "load_s": round(load_s, 2),
+        "snapshot_mb": round(size_mb, 1),
+        "query_parity": before == after,
+    }
+    _log(f"checkpoint: save {save_s:.1f}s, load {load_s:.1f}s, "
+         f"{size_mb:.0f}MB, parity={before == after}")
+    return out
+
+
+def preflight_backend(timeout_s: float = 90.0):
+    """Bounded accelerator probe: initialize the default jax backend in a
+    SUBPROCESS and run one tiny computation, with a hard timeout.
+
+    A wedged axon tunnel makes ``jax.devices()`` block indefinitely in
+    whatever process first touches it (NOTES_r03 §7); round 3's bench sat
+    through a 25-minute backend-init hang before its except-clause fired.
+    Probing in a killable child bounds that to ``timeout_s`` and leaves
+    THIS process's jax uninitialized, so on failure we can still flip to
+    the CPU platform and produce device-path evidence.
+
+    Returns (ok, info_str). ok means: an accelerator platform initialized
+    and executed an op within the timeout.
+    """
+    import subprocess
+
+    code = (
+        "import jax, jax.numpy as jnp; d = jax.devices(); "
+        "print('PLATFORM', d[0].platform, len(d), flush=True); "
+        "print('SUM', float(jnp.ones(8).sum()), flush=True)"
+    )
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init exceeded {timeout_s:.0f}s (wedged tunnel?)"
+    dt = time.perf_counter() - t0
+    tail = (proc.stdout or "").strip().splitlines()
+    if proc.returncode != 0:
+        return False, f"probe rc={proc.returncode}: {tail[-1] if tail else ''}"
+    plat = ""
+    for line in tail:
+        if line.startswith("PLATFORM "):
+            plat = line.split()[1]
+    if plat in ("", "cpu"):
+        return False, f"no accelerator platform registered (got {plat!r})"
+    return True, f"{plat} ok in {dt:.1f}s"
+
+
 def bench_compare_kernels(total_spans: int = 10_000_000):
     """XLA scatter vs pallas VMEM-resident histogram ingest, same stream
     (the measured decision VERDICT r2 asked for)."""
@@ -405,13 +594,34 @@ def main():
     ap.add_argument("--compare-kernels", action="store_true")
     ap.add_argument("--spans", type=float, default=None,
                     help="TPU stream length (default 1e8, smoke 2e5)")
+    ap.add_argument("--preflight-timeout", type=float, default=90.0,
+                    help="seconds to wait for accelerator backend init")
     args = ap.parse_args()
 
-    # The SQL CPU reference first: it needs no device, so even a dead
-    # TPU backend still yields a valid one-line JSON result instead of
-    # an empty benchmark record.
+    detail = {}
+    # Bounded backend preflight BEFORE anything touches jax in this
+    # process: a dead tunnel costs at most --preflight-timeout, then the
+    # harness degrades to CPU (smoke shapes, for the full config) so the
+    # record always carries device-path evidence — never a bare zero, and
+    # never a multi-minute hang inside backend init (both happened in r3).
+    ok, info = preflight_backend(args.preflight_timeout)
+    detail["backend_preflight"] = info
+    if not ok:
+        _log(f"backend preflight failed ({info}); forcing CPU platform")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        if not args.smoke:
+            args.smoke = True
+            detail["fallback_cpu_smoke"] = True
+    else:
+        _log(f"backend preflight: {info}")
+
+    # The SQL CPU reference: it needs no device, so even a dead TPU
+    # backend still yields a valid one-line JSON result instead of an
+    # empty benchmark record.
     sql = bench_sql_baseline(total_spans=2_000 if args.smoke else 10_000)
-    detail = {"config1_sql_cpu_reference": sql}
+    detail["config1_sql_cpu_reference"] = sql
     ingest = None
     try:
         if args.smoke:
@@ -425,6 +635,10 @@ def main():
         detail["tpu_queries"] = bench_tpu_queries(
             store, reps=5 if args.smoke else 12
         )
+        detail["index_exactness"] = bench_exactness(
+            store, n_queries=9 if args.smoke else 24
+        )
+        detail["checkpoint_at_scale"] = bench_checkpoint(store)
         if args.compare_kernels:
             del store  # free HBM before the second stream
             detail["compare_kernels"] = bench_compare_kernels(

@@ -144,3 +144,80 @@ def test_stored_span_count_sources():
     sql.apply(spans)
     assert sql.stored_span_count() == float(len(spans))
     sql.close()
+
+
+def test_client_server_halves_order_independent_links():
+    """The client and server halves of an RPC share (trace_id, span_id)
+    in the span table; parent attribution for their children must not
+    depend on which half arrived first (_tab_insert's scatter-min keeps
+    the lowest service id deterministically — COVERAGE.md row 3)."""
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+
+    cl = Endpoint(1, 1, "alpha-client")
+    sv = Endpoint(2, 2, "beta-server")
+    child_ep = Endpoint(3, 3, "gamma-child")
+    client_half = Span(99, "rpc", 5, None,
+                       (Annotation(10, "cs", cl), Annotation(40, "cr", cl)),
+                       ())
+    server_half = Span(99, "rpc", 5, None,
+                       (Annotation(20, "sr", sv), Annotation(30, "ss", sv)),
+                       ())
+    child = Span(99, "leaf", 6, 5,
+                 (Annotation(22, "sr", child_ep),
+                  Annotation(28, "ss", child_ep)), ())
+
+    def links(order):
+        store = TpuSpanStore(CONFIG)
+        # Intern every service first so dictionary ids don't depend on
+        # the arrival order under test.
+        for name in ("alpha-client", "beta-server", "gamma-child"):
+            store.dicts.services.encode(name)
+        for s in order:
+            store.apply([s])
+        deps = store.get_dependencies()
+        return sorted((l.parent, l.child, l.duration_moments.count)
+                      for l in deps.links)
+
+    a = links([client_half, server_half, child])
+    b = links([server_half, client_half, child])
+    c = links([client_half, server_half, child][::-1])
+    assert a == b == c
+    assert any(child_name == "gamma-child" for _, child_name, _ in a)
+
+
+def test_chained_ingest_steps_bitwise_matches_sequential():
+    """dev.ingest_steps (k batches per launch via lax.scan) must land
+    bitwise-identical state to k sequential ingest_step launches."""
+    batches = _device_batches(n_batches=4)
+    seq = dev.init_state(CONFIG)
+    for db in batches:
+        seq = dev.ingest_step(seq, jax.device_put(db))
+    stacked = dev.stack_device_batches(batches)
+    chained = dev.ingest_steps(dev.init_state(CONFIG), stacked)
+    for a, b in zip(_leaves(seq), _leaves(chained)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_store_chained_writes_bitwise_match_single(monkeypatch):
+    """TpuSpanStore._write_parts grouping (multi-chunk launches) must
+    not change the stored state vs one-launch-per-chunk."""
+    from zipkin_tpu.tracegen import generate_traces
+
+    spans = [s for t in generate_traces(n_traces=120, max_depth=3,
+                                        n_services=6) for s in t]
+    cfg = dev.StoreConfig(
+        capacity=256, ann_capacity=1024, bann_capacity=512,
+        max_services=16, max_span_names=64, max_annotation_values=128,
+        max_binary_keys=32, cms_width=256, hll_p=6, quantile_buckets=128,
+    )
+    chained = TpuSpanStore(cfg)
+    single = TpuSpanStore(cfg)
+    monkeypatch.setattr(TpuSpanStore, "CHAIN_SIZES", (),
+                        raising=True)
+    single.apply(spans)
+    monkeypatch.undo()
+    assert chained.CHAIN_SIZES == (16, 8, 4)
+    chained.apply(spans)
+    assert len(spans) > 2 * chained._max_chunk_spans()  # really chained
+    for a, b in zip(_leaves(chained.state), _leaves(single.state)):
+        np.testing.assert_array_equal(a, b)

@@ -191,10 +191,12 @@ def test_index_first_topk_gating():
         scan_calls.append(k)
         return [(1, 100), (2, 90)], False
 
-    def run(cands, complete, wm, limit=2):
+    def run(cands, complete, wm, limit=2, window=None):
         scan_calls.clear()
         return index_first_topk(
-            limit, 1 << 20, lambda k: (cands, complete, wm),
+            limit, 1 << 20,
+            lambda k: (cands, complete, wm,
+                       k if window is None else window),
             scan,
         ), bool(scan_calls)
 
@@ -217,6 +219,98 @@ def test_index_first_topk_gating():
     # Wrapped + underfull: must scan.
     ids, scanned = run([(1, 100)], False, -1)
     assert scanned
+    # Complete + kernel-clamped window that FILLED: the candidates may
+    # have been truncated by the clamp, so 'underfull' must be judged
+    # against the kernel's real window, not the requested k — must
+    # scan. (Regression: the two-bucket binary-value probe trusted a
+    # silently cut window; caught by the 3-store oracle parity drive.)
+    ids, scanned = run([(1, 100 - i) for i in range(12)], True, -1,
+                       window=12)
+    assert scanned
+
+
+def _three_host_span(tid=777, marker="middle marker"):
+    """A span whose annotations carry THREE distinct host services: the
+    (min, max) host-pair index entries skip the middle host entirely."""
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+
+    a = Endpoint(1, 1, "svc-lo")
+    b = Endpoint(2, 2, "svc-mid")
+    c = Endpoint(3, 3, "svc-hi")
+    return Span(tid, "op", 1, None, (
+        Annotation(100, "cs", a),
+        Annotation(110, marker, b),
+        Annotation(120, "sr", c),
+    ), ())
+
+
+def test_middle_host_annotation_query_stays_exact():
+    """A 3+-distinct-host span is indexed under its (min, max) hosts
+    only; a query under the MIDDLE host must not trust the (incomplete
+    yet never-wrapped) fast-path bucket — ann_poison forces the scan,
+    which finds the span (per-slot semantics)."""
+    span = _three_host_span()
+    spans = [span] + SPANS  # interleave real traffic
+    fast, scan = _pair(spans)
+    for svc in ("svc-lo", "svc-mid", "svc-hi"):
+        got = _ids(fast.get_trace_ids_by_annotation(
+            svc, "middle marker", None, END_TS, 10))
+        want = _ids(scan.get_trace_ids_by_annotation(
+            svc, "middle marker", None, END_TS, 10))
+        assert got == want, svc
+        # Per-slot semantics: the span carries the marker AND has an
+        # annotation hosted by each of the three services.
+        assert any(t == 777 for t, _ in want), svc
+    # The middle-host query really does return the span via the scan.
+    assert any(
+        t == 777 for t, _ in _ids(fast.get_trace_ids_by_annotation(
+            "svc-mid", "middle marker", None, END_TS, 10))
+    )
+    # Binary-annotation queries under the middle host share the gate.
+    for svc in ("svc-lo", "svc-mid", "svc-hi"):
+        assert _ids(fast.get_trace_ids_by_annotation(
+            svc, "http.uri", b"/api/widgets", END_TS, 10
+        )) == _ids(scan.get_trace_ids_by_annotation(
+            svc, "http.uri", b"/api/widgets", END_TS, 10
+        )), svc
+
+
+def test_middle_host_poison_self_heals_after_eviction():
+    """The poison is a displaced-gid gate, not a permanent flag: once
+    the 3-host span is evicted (a full ring turnover later), the
+    middle-host service's fast path is trusted again."""
+    import numpy as np
+
+    kw = dict(capacity=64, ann_capacity=512, bann_capacity=256)
+    fast = TpuSpanStore(_cfg(True, **kw))
+    # Same ring geometry for the oracle: eviction must be identical or
+    # a parity comparison is meaningless.
+    scan = TpuSpanStore(_cfg(False, **kw))
+    span = _three_host_span()
+    filler = [s for t in generate_traces(n_traces=40, max_depth=3,
+                                         n_services=4) for s in t]
+    assert len(filler) >= 64, "generator must fill the ring for this test"
+    for st in (fast, scan):
+        st.apply([span])
+        st.apply(filler)
+    svc_mid = fast.dicts.services.get("svc-mid")
+    assert svc_mid is not None
+    poison = int(np.asarray(fast.state.ann_poison)[svc_mid])
+    wp = int(fast.state.write_pos)
+    # Ring turned over: the gate must have expired.
+    assert poison < wp - fast.config.capacity
+    # And fast-path results stay exact — on a query the data really
+    # matches (both stores non-empty), not a vacuous [] == [].
+    end2 = max(s.last_timestamp for s in filler if s.last_timestamp) + 1
+    nonempty = 0
+    for svc in sorted(scan.get_all_service_names()):
+        got = fast.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, end2, 10)
+        want = scan.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, end2, 10)
+        assert _ids(got) == _ids(want), svc
+        nonempty += bool(want)
+    assert nonempty > 0
 
 
 def test_duplicate_trace_ids_in_request():
@@ -279,6 +373,63 @@ def test_pre_index_snapshot_poisons_trust(tmp_path):
         == _ids(store.get_trace_ids_by_name(svc, None, end_ts, 10))
 
 
+def test_pre_rev7_snapshot_disables_key_table(tmp_path):
+    """A revision-6 snapshot predates the per-key cursor table: its
+    displacement history is unrecoverable, so post-restore key claims
+    must NEVER certify completeness (the claim-is-first-record
+    invariant doesn't cross the restore boundary). load() tombstones
+    the table; post-restore ingest and queries stay exact via the
+    bucket gates."""
+    import json
+    import os
+
+    import numpy as np
+
+    from zipkin_tpu import checkpoint
+    from zipkin_tpu.store.device import I64_MIN
+
+    store = TpuSpanStore(_cfg(True))
+    spans = [s for t in generate_traces(n_traces=6, max_depth=3,
+                                        n_services=4) for s in t]
+    store.apply(spans)
+    path = str(tmp_path / "rev6")
+    checkpoint.save(store, path)
+    state_file = os.path.join(path, "state.npz")
+    data = dict(np.load(state_file))
+    for k in ("key_tab", "key_wm", "ann_poison"):
+        del data[k]
+    np.savez_compressed(state_file, **data)
+    meta_file = os.path.join(path, "meta.json")
+    with open(meta_file) as f:
+        meta = json.load(f)
+    meta["revision"] = 6
+    meta["config"].pop("idx_key_slots", None)
+    with open(meta_file, "w") as f:
+        json.dump(meta, f)
+
+    restored = checkpoint.load(path)
+    # Table tombstoned: every word is the un-claimable sentinel.
+    assert (np.asarray(restored.state.key_tab) == I64_MIN).all()
+    # New ingest can't resurrect key trust...
+    more = [s for t in generate_traces(n_traces=4, max_depth=3,
+                                       n_services=4) for s in t]
+    restored.apply(more)
+    assert (np.asarray(restored.state.key_tab) == I64_MIN).all()
+    # ...and reads stay exact vs a never-snapshotted oracle.
+    oracle = TpuSpanStore(_cfg(False))
+    oracle.apply(spans)
+    oracle.apply(more)
+    end_ts = max(
+        s.last_timestamp for s in spans + more if s.last_timestamp
+    ) + 1
+    for svc in sorted(oracle.get_all_service_names()):
+        assert _ids(restored.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, end_ts, 10
+        )) == _ids(oracle.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, end_ts, 10
+        )), svc
+
+
 def test_eviction_through_index():
     """Evicted spans must vanish from index results (gid round-trip
     liveness), exactly as they vanish from the scan."""
@@ -298,3 +449,113 @@ def test_eviction_through_index():
         ) == _ids(
             small_scan.get_trace_ids_by_name(svc, None, end_ts, 10)
         ), svc
+
+
+def test_get_trace_ids_multi_matches_singular():
+    """The one-launch batched read must answer every query exactly as
+    the singular paths (and the scan-only oracle) do."""
+    fast, scan = _pair(SPANS)
+    queries = []
+    for svc in sorted(scan.get_all_service_names()):
+        queries.append(("name", svc, None, END_TS, 10))
+        names = sorted(scan.get_span_names(svc))
+        if names:
+            queries.append(("name", svc, names[0], END_TS, 5))
+        queries.append(
+            ("annotation", svc, "some custom annotation", None, END_TS, 10))
+        queries.append(
+            ("annotation", svc, "http.uri", b"/api/widgets", END_TS, 10))
+        queries.append(("annotation", svc, "http.uri", None, END_TS, 10))
+    queries.append(("name", "no-such-service", None, END_TS, 10))
+    queries.append(("annotation", "no-such-service", "x", None, END_TS, 10))
+    queries.append(("name", queries[0][1], None, END_TS, 0))  # limit 0
+    got = fast.get_trace_ids_multi(queries)
+    assert len(got) == len(queries)
+    for q, ids in zip(queries, got):
+        if q[0] == "name":
+            want = scan.get_trace_ids_by_name(*q[1:])
+        else:
+            want = scan.get_trace_ids_by_annotation(*q[1:])
+        assert _ids(ids) == _ids(want), q
+
+
+def test_get_trace_ids_multi_wrapped_buckets_fall_back():
+    """Distrusted buckets inside a batched read drop to the singular
+    scan path per query — results must still match the oracle."""
+    fast, scan = _pair(
+        SPANS,
+        idx_service_depth=64, idx_name_buckets=256, idx_name_depth=64,
+        idx_ann_buckets=256, idx_ann_depth=64, idx_bann_buckets=256,
+        idx_bann_depth=32,
+    )
+    queries = []
+    for svc in sorted(scan.get_all_service_names()):
+        queries.append(("name", svc, None, END_TS, 10))
+        queries.append(
+            ("annotation", svc, "some custom annotation", None, END_TS, 10))
+    got = fast.get_trace_ids_multi(queries)
+    for q, ids in zip(queries, got):
+        if q[0] == "name":
+            want = scan.get_trace_ids_by_name(*q[1:])
+        else:
+            want = scan.get_trace_ids_by_annotation(*q[1:])
+        assert _ids(ids) == _ids(want), q
+
+
+def test_get_trace_ids_multi_middle_host_poison():
+    """Batched reads honor the ann_poison middle-host gate too."""
+    span = _three_host_span()
+    fast, scan = _pair([span] + SPANS)
+    queries = [
+        ("annotation", svc, "middle marker", None, END_TS, 10)
+        for svc in ("svc-lo", "svc-mid", "svc-hi")
+    ]
+    got = fast.get_trace_ids_multi(queries)
+    for q, ids in zip(queries, got):
+        want = scan.get_trace_ids_by_annotation(*q[1:])
+        assert _ids(ids) == _ids(want), q
+        assert any(t == 777 for t, _ in _ids(ids)), q
+
+
+def test_sparse_key_under_hot_bucket_stays_on_fast_path():
+    """The per-key cursor table (StoreState.key_tab): a sparse
+    (service, annotation-value) pair whose hashed bucket is wrapped by a
+    hot bucket-mate must still answer from the index — its own entries
+    were never displaced, so its key record proves the window complete
+    (NOTES_r03 §4's 'known fallback', closed by VERDICT r3 item 5)."""
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+
+    # One annotation bucket: every (service, value) pair is bucket-mates
+    # with every other — the aliasing worst case, deterministically.
+    cfg = _cfg(True, idx_ann_buckets=1, idx_ann_depth=64)
+    fast, scan = TpuSpanStore(cfg), TpuSpanStore(_cfg(False))
+    ep = Endpoint(1, 80, "websvc")
+    ts = [1000]
+
+    def span(i, value):
+        ts[0] += 10
+        return Span(10_000 + i, "op", 1, None,
+                    (Annotation(ts[0], "sr", ep),
+                     Annotation(ts[0] + 1, value, ep)), ())
+
+    spans = [span(i, "hot marker") for i in range(150)]
+    spans += [span(200 + j, "rare marker") for j in range(2)]
+    spans += [span(300 + i, "hot marker") for i in range(40)]
+    for st in (fast, scan):
+        st.apply(spans)
+    end_ts = ts[0] + 10
+    assert fast.index_fallbacks == 0
+    got = fast.get_trace_ids_by_annotation(
+        "websvc", "rare marker", None, end_ts, 10)
+    want = scan.get_trace_ids_by_annotation(
+        "websvc", "rare marker", None, end_ts, 10)
+    assert _ids(got) == _ids(want)
+    assert sorted(t for t, _ in _ids(got)) == [10200, 10201]
+    # The rare pair answered from the index: no scan fallback despite
+    # its bucket having wrapped 3x on the hot pair's traffic.
+    assert fast.index_fallbacks == 0 and fast.index_hits == 1
+    # The batched path honors the same gate.
+    multi = fast.get_trace_ids_multi(
+        [("annotation", "websvc", "rare marker", None, end_ts, 10)])
+    assert _ids(multi[0]) == _ids(want)
+    assert fast.index_fallbacks == 0

@@ -30,7 +30,9 @@ _STATE_FILE = "state.npz"
 _META_FILE = "meta.json"
 _PINS_FILE = "pins.pkl"
 # Bump when the StoreState schema changes in a way load() must adapt to.
-_REVISION = 6
+# 7: span_tab empty sentinel 0 → _TAB_EMPTY (deterministic min-insert);
+#    ann_poison middle-host trust array added.
+_REVISION = 7
 
 
 def _dict_dump(d) -> list:
@@ -229,7 +231,18 @@ def load(path: str, mesh=None):
     # restored span from the fast paths. Poison index trust so the
     # exact scan kernels serve instead (load() applies below).
     pre_index = revision < 6
+    # Revision < 7: the span table used 0 as its empty sentinel (now
+    # _TAB_EMPTY, for deterministic min-insert), and ann_poison didn't
+    # exist — any restored span might be a 3+-distinct-host span whose
+    # middle hosts were never indexed, so stamp every service poisoned
+    # until the ring turns over (dev.poison_ann_trust below).
+    pre_poison = revision < 7
     upd = {k: v for k, v in upd.items() if k in known}
+    if pre_poison and "span_tab" in upd:
+        tab = np.asarray(upd["span_tab"])
+        upd["span_tab"] = jax.numpy.asarray(
+            np.where(tab == 0, dev._TAB_EMPTY, tab)
+        )
     if legacy:
         _migrate_legacy_live_links(data, upd, config, n_shards)
     if "dep_banks" not in upd:
@@ -261,6 +274,10 @@ def load(path: str, mesh=None):
                 store.inner.states = dev.poison_index_trust(
                     store.inner.states
                 )
+            if pre_poison:
+                store.inner.states = dev.poison_ann_trust(
+                    store.inner.states
+                )
             if legacy:
                 store.inner.states = _sharded_rebuild_tab(
                     mesh, store.inner.states
@@ -275,6 +292,8 @@ def load(path: str, mesh=None):
         store.state = store.state.replace(**upd)
         if pre_index:
             store.state = dev.poison_index_trust(store.state)
+        if pre_poison:
+            store.state = dev.poison_ann_trust(store.state)
         if legacy:
             # The pre-rev-4 schema had no span table: re-insert resident
             # spans so post-restore children still find their parents.

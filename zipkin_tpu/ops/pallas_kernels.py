@@ -22,6 +22,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
 DEFAULT_TILE = 1024
@@ -31,11 +32,18 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _hist_kernel(idx_ref, w_ref, out_ref, *, tile: int):
-    # idx_ref/w_ref blocks are (1, 8, tile//8) to satisfy the TPU
-    # (sublane, lane) tiling; iterate the tile in flat order.
+def _hist_kernel(idx_ref, w_ref, out_ref):
+    # idx_ref/w_ref are SMEM-resident (1, tile) blocks: SMEM is the TPU
+    # memory built for data-dependent SCALAR reads, so ``idx_ref[0, t]``
+    # with a loop-carried ``t`` lowers cleanly — the earlier VMEM
+    # variant's dynamic LANE index was what Mosaic rejected ("cannot
+    # statically prove index in dimension 2 is a multiple of 128",
+    # NOTES_r03.md §6). The output stays VMEM-resident across the whole
+    # grid (same block for every step); updates are row-granular
+    # read-modify-writes with a one-hot lane add — dynamic SUBLANE
+    # indexing is legal.
     i = pl.program_id(0)
-    sub = tile // LANES
+    tile = idx_ref.shape[1]
 
     @pl.when(i == 0)
     def _():
@@ -43,13 +51,9 @@ def _hist_kernel(idx_ref, w_ref, out_ref, *, tile: int):
 
     # Shift/mask instead of //,% — LANES is 128 — and int32 loop bounds:
     # pallas TPU has no 64-bit lowering, and x64 mode would make a plain
-    # python-int fori_loop index int64. Mosaic cannot store scalars to
-    # VMEM, so each update is a row-granular read-modify-write with a
-    # one-hot lane add.
+    # python-int fori_loop index int64.
     def body(t, carry):
-        tr = t >> 7
-        tc = t & 127
-        b = idx_ref[0, tr, tc]
+        b = idx_ref[0, t]
 
         @pl.when(b >= 0)
         def _():
@@ -57,7 +61,7 @@ def _hist_kernel(idx_ref, w_ref, out_ref, *, tile: int):
             c = b & 127
             row = out_ref[pl.ds(r, 1), :]
             lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
-            onehot = (lane == c).astype(row.dtype) * w_ref[0, tr, tc]
+            onehot = (lane == c).astype(row.dtype) * w_ref[0, t]
             out_ref[pl.ds(r, 1), :] = row + onehot
 
         return carry
@@ -74,25 +78,26 @@ def flat_histogram(idx, weights, m: int, tile: int = DEFAULT_TILE):
     """
     assert m % LANES == 0, "histogram size must be a multiple of 128"
     assert tile % LANES == 0, "tile must be a multiple of 128"
-    sub = tile // LANES
     n = idx.shape[0]
     n_tiles = -(-n // tile)
     pad = n_tiles * tile - n
     idx = jnp.pad(jnp.asarray(idx, jnp.int32), (0, pad), constant_values=-1)
     weights = jnp.pad(jnp.asarray(weights), (0, pad))
-    idx3 = idx.reshape(n_tiles, sub, LANES)
-    w3 = weights.reshape(n_tiles, sub, LANES)
+    idx2 = idx.reshape(n_tiles, tile)
+    w2 = weights.reshape(n_tiles, tile)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, tile=tile),
+        _hist_kernel,
         grid=(n_tiles,),
         in_specs=[
-            pl.BlockSpec((1, sub, LANES), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, sub, LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tile), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, tile), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((m // LANES, LANES), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((m // LANES, LANES), w3.dtype),
+        out_shape=jax.ShapeDtypeStruct((m // LANES, LANES), w2.dtype),
         interpret=_interpret(),
-    )(idx3, w3)
+    )(idx2, w2)
     return out.reshape(m)
 
 

@@ -284,7 +284,11 @@ class ShardedSpanStore:
         # defined (see TpuSpanStore._chunk_columnar). Split-and-retry;
         # a single span fatter than an annotation ring gets truncated.
         c = self.config
-        cap = max(1, c.capacity // 2)
+        # A launch's unresolved children must also fit the pending ring
+        # without self-collision (the same bound TpuSpanStore applies in
+        # _max_chunk_spans): pslot = (pend_pos + rank) % pending_slots
+        # would scatter colliding slots within one launch otherwise.
+        cap = max(1, min(c.capacity // 2, c.pending_slots))
 
         def oversized(g):
             return (len(g) > cap
@@ -399,7 +403,7 @@ class ShardedSpanStore:
                         st.row_gid, st.indexable, st.trace_id, st.ts_last,
                         c.capacity, fam, min(limit, fam[3]),
                         (svc.astype(jnp.int32), name_lc.astype(jnp.int32)),
-                        end_ts,
+                        end_ts, st.key_tab, st.key_wm, st.write_pos,
                     )
                 else:
                     fam = lay[dev.StoreConfig.CAND_SVC]
@@ -436,6 +440,8 @@ class ShardedSpanStore:
                         st.row_gid, st.indexable, st.trace_id, st.ts_last,
                         c.capacity, fam, min(limit, fam[3]),
                         (svc32, ann.astype(jnp.int32)), end_ts,
+                        st.key_tab, st.key_wm, st.write_pos,
+                        st.ann_poison,
                     )
                 elif mode == "bkey":
                     fam = lay[dev.StoreConfig.CAND_BANN]
@@ -444,19 +450,23 @@ class ShardedSpanStore:
                         st.row_gid, st.indexable, st.trace_id, st.ts_last,
                         c.capacity, fam, min(limit, fam[3]),
                         (svc32, bkey.astype(jnp.int32), jnp.int32(-1)),
-                        end_ts,
+                        end_ts, st.key_tab, st.key_wm, st.write_pos,
+                        st.ann_poison,
                     )
                 else:
                     fam = lay[dev.StoreConfig.CAND_BANN]
+                    # 2-bucket window: clamp to 2*depth, not depth (see
+                    # dev.iquery_trace_ids_by_annotation).
                     mat, complete, wm = dev._iq_verify2_impl(
                         st.cand_idx, st.cand_pos, st.cand_wm,
                         st.row_gid, st.indexable, st.trace_id, st.ts_last,
-                        c.capacity, fam, min(limit, fam[3]),
+                        c.capacity, fam, min(limit, 2 * fam[3]),
                         (svc32, bkey.astype(jnp.int32),
                          bval.astype(jnp.int32)),
                         (svc32, bkey.astype(jnp.int32),
                          bval2.astype(jnp.int32)),
-                        end_ts,
+                        end_ts, st.key_tab, st.key_wm, st.write_pos,
+                        st.ann_poison,
                     )
                 return mat[None], complete[None], wm[None]
 
@@ -624,8 +634,13 @@ class ShardedSpanStore:
 
     @staticmethod
     def _shard_candidates(mats: np.ndarray, k: int):
-        """Flatten per-shard candidate matrices [n, 3, k]; truncated if
-        ANY shard filled its window."""
+        """Flatten per-shard candidate matrices [n, 3, kk]; truncated if
+        ANY shard filled its window. The window bound is the kernel's
+        ACTUAL slot count (kk = mats.shape[-1]), which may be clamped
+        below the requested k by bucket geometry — comparing against
+        the requested k would let a full clamped window read as
+        untruncated."""
+        kk = min(k, mats.shape[-1])
         cands, truncated = [], False
         for sh in range(mats.shape[0]):
             n_valid = 0
@@ -633,7 +648,7 @@ class ShardedSpanStore:
                 if v:
                     cands.append((int(t), int(ts)))
                     n_valid += 1
-            truncated |= n_valid >= k
+            truncated |= n_valid >= kk
         return cands, truncated
 
     def get_trace_ids_by_name(self, service_name, span_name, end_ts, limit):
@@ -665,8 +680,11 @@ class ShardedSpanStore:
                         jnp.int64(end_ts),
                     )
                 )
-            cands, _ = self._shard_candidates(mats, k)
-            return cands, bool(np.all(complete)), int(np.max(wm))
+            cands, truncated = self._shard_candidates(mats, k)
+            # window > len(cands) ⇔ no shard's window truncated: only
+            # then may the underfull-equals-complete claim fire.
+            window = len(cands) if truncated else len(cands) + 1
+            return cands, bool(np.all(complete)), int(np.max(wm)), window
 
         from zipkin_tpu.store.base import index_first_topk
 
@@ -725,8 +743,9 @@ class ShardedSpanStore:
                         jnp.int32(bv2), jnp.int64(end_ts),
                     )
                 )
-            cands, _ = self._shard_candidates(mats, k)
-            return cands, bool(np.all(complete)), int(np.max(wm))
+            cands, truncated = self._shard_candidates(mats, k)
+            window = len(cands) if truncated else len(cands) + 1
+            return cands, bool(np.all(complete)), int(np.max(wm)), window
 
         from zipkin_tpu.store.base import index_first_topk
 
@@ -739,6 +758,14 @@ class ShardedSpanStore:
         return topk_ids_with_escalation(
             limit, c.ann_capacity + c.bann_capacity, fetch
         )
+
+    def get_trace_ids_multi(self, queries):
+        """Generic per-query loop (ReadSpanStore contract). The sharded
+        store still pays one launch per slice; folding multi-probe into
+        the per-shard kernels is future work."""
+        from zipkin_tpu.store.base import ReadSpanStore
+
+        return ReadSpanStore.get_trace_ids_multi(self, queries)
 
     # -- trace reads -----------------------------------------------------
 

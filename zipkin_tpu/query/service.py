@@ -55,14 +55,19 @@ class QueryService:
             return self._response(self._query_slices(slices, qr), qr)
         # Multi-slice: probe each slice at limit 1 to find the latest
         # timestamp they can all reach, pad by one minute, re-query all
-        # slices aligned there, then intersect.
-        probes = self._query_slices(slices, qr, limit=1)
+        # slices aligned there, then intersect. Both rounds ride the
+        # store's batched multi-query path (one device launch per round
+        # on the TPU store, instead of one per slice).
+        probes = [
+            i for ids in self.store.get_trace_ids_multi(
+                [self._multi_query(s, qr, qr.end_ts, 1) for s in slices]
+            ) for i in ids
+        ]
         probe_ts = [i.timestamp for i in probes]
         aligned = (min(probe_ts) if probe_ts else 0) + TRACE_TIMESTAMP_PADDING_US
-        per_slice = [
-            self._query_one(s, qr, end_ts=aligned, limit=qr.limit)
-            for s in slices
-        ]
+        per_slice = self.store.get_trace_ids_multi([
+            self._multi_query(s, qr, aligned, qr.limit) for s in slices
+        ])
         common = _intersect(per_slice)
         if not common:
             # Nothing common: report the best next endTs for pagination.
@@ -81,6 +86,14 @@ class QueryService:
         for b in qr.binary_annotations:
             slices.append(("annotation", b.key, b.value))
         return slices
+
+    @staticmethod
+    def _multi_query(s, qr: QueryRequest, end_ts: int, limit: int) -> tuple:
+        """One slice as a SpanStore.get_trace_ids_multi query tuple."""
+        kind, key, value = s
+        if kind == "span":
+            return ("name", qr.service_name, key, end_ts, limit)
+        return ("annotation", qr.service_name, key, value, end_ts, limit)
 
     def _query_one(self, s, qr: QueryRequest, end_ts: int, limit: int
                    ) -> List[IndexedTraceId]:

@@ -226,25 +226,13 @@ def topk_ids_with_escalation(limit: int, k_max: int, fetch,
         k = min(k * 8, k_max)
 
 
-def index_first_topk(limit: int, k_max: int, index_fetch,
-                     scan_fetch) -> List["IndexedTraceId"]:
-    """Index fast path with scan fallback, the shared read policy of the
-    device stores. ``index_fetch(k)`` reads an O(depth) index bucket and
-    returns (candidates, complete, watermark):
-
-    - ``complete`` — the bucket never wrapped, so it holds every entry
-      ever written for the key: the result is exact, full stop.
-    - otherwise the bucket holds its newest entries, and ``watermark``
-      is the max ts ever displaced from it: the result is exact iff the
-      limit-th ranked candidate still sits at or above the watermark
-      (every span the index no longer holds ranks at or below it).
-
-    Anything else falls back to the O(ring) scan kernel's escalation.
-    Near-monotonic traffic (the normal case: spans arrive roughly in
-    timestamp order) keeps wrapped buckets trusted; shuffled arrival
-    degrades to the scan, never to a wrong answer."""
-    k = limit * 8
-    candidates, complete, watermark = index_fetch(k)
+def index_topk_or_none(limit: int, k: int, candidates, complete,
+                       watermark) -> Optional[List["IndexedTraceId"]]:
+    """The index trust gate as a pure function over an already-fetched
+    bucket window of ``k`` candidate slots; None means the window can't
+    be trusted and the caller must scan. Shared by the per-query path
+    (index_first_topk) and the batched multi-probe path
+    (TpuSpanStore.get_trace_ids_multi)."""
     ids = dedup_rank_limit(candidates, limit)
     if len(ids) >= limit:
         # A complete bucket's top candidates are exact; a wrapped one's
@@ -254,6 +242,39 @@ def index_first_topk(limit: int, k_max: int, index_fetch,
     elif complete and len(candidates) < k:
         # Every entry the bucket has ever held was inside the top-k
         # window: the underfull result is the true, full answer.
+        return ids
+    return None
+
+
+def index_first_topk(limit: int, k_max: int, index_fetch,
+                     scan_fetch) -> List["IndexedTraceId"]:
+    """Index fast path with scan fallback, the shared read policy of the
+    device stores. ``index_fetch(k)`` reads an O(depth) index bucket and
+    returns (candidates, complete, watermark, window):
+
+    - ``complete`` — the bucket never wrapped, so it holds every entry
+      ever written for the key: the result is exact, full stop.
+    - otherwise the bucket holds its newest entries, and ``watermark``
+      is the max ts ever displaced from it: the result is exact iff the
+      limit-th ranked candidate still sits at or above the watermark
+      (every span the index no longer holds ranks at or below it).
+    - ``window`` — the number of candidate slots the kernel ACTUALLY
+      returned (it may clamp the requested k to its bucket geometry).
+      The underfull-equals-complete claim compares against this, never
+      against the requested k: a kernel-truncated window full of
+      candidates must read as saturated, not underfull (a saturated
+      window silently cut real candidates — the bug the 3-store oracle
+      parity drive caught in the two-bucket binary-value probe).
+
+    Anything else falls back to the O(ring) scan kernel's escalation.
+    Near-monotonic traffic (the normal case: spans arrive roughly in
+    timestamp order) keeps wrapped buckets trusted; shuffled arrival
+    degrades to the scan, never to a wrong answer."""
+    k = limit * 8
+    candidates, complete, watermark, window = index_fetch(k)
+    ids = index_topk_or_none(limit, min(k, window), candidates,
+                             complete, watermark)
+    if ids is not None:
         return ids
     return topk_ids_with_escalation(limit, k_max, scan_fetch)
 
@@ -448,6 +469,33 @@ class ReadSpanStore(abc.ABC):
         limit: int,
     ) -> List[IndexedTraceId]:
         ...
+
+    def get_trace_ids_multi(self, queries) -> List[List[IndexedTraceId]]:
+        """Resolve several independent trace-id queries at once. Each
+        query is a tuple:
+
+        - ``("name", service_name, span_name_or_None, end_ts, limit)``
+        - ``("annotation", service_name, annotation, value_or_None,
+          end_ts, limit)``
+
+        The generic implementation loops over the singular methods;
+        device stores override it to fold every query's index probe
+        into a single kernel launch (the batched analogue of the
+        reference resolving a request's slices with separate index
+        reads, ThriftQueryService.scala:166-196)."""
+        out: List[List[IndexedTraceId]] = []
+        for q in queries:
+            if q[0] == "name":
+                _, svc, name, end_ts, limit = q
+                out.append(
+                    self.get_trace_ids_by_name(svc, name, end_ts, limit)
+                )
+            else:
+                _, svc, ann, value, end_ts, limit = q
+                out.append(self.get_trace_ids_by_annotation(
+                    svc, ann, value, end_ts, limit
+                ))
+        return out
 
     @abc.abstractmethod
     def get_traces_duration(self, trace_ids: Sequence[int]) -> List[TraceIdDuration]:

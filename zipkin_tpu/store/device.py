@@ -101,6 +101,9 @@ class StoreConfig(NamedTuple):
     # (everything a bucket displaced is already evicted) true in steady
     # state — see _gid_index_write.
     idx_trace_buckets: int = 0
+    # Per-key cursor table slots (0 = 2x total candidate buckets). See
+    # StoreState.key_tab.
+    idx_key_slots: int = 0
     # Route ingest scatter-adds through the VMEM-resident pallas
     # histogram kernels (ops/pallas_kernels.py) instead of XLA scatter.
     # Benchmarked on the real chip by bench.py --compare-kernels; arrays
@@ -189,6 +192,12 @@ class StoreConfig(NamedTuple):
         ))
 
     CAND_SVC, CAND_NAME, CAND_ANN, CAND_BANN = range(4)
+
+    @property
+    def key_slots(self) -> int:
+        return _next_pow2_int(
+            self.idx_key_slots or 2 * self.cand_layout[1]
+        )
 
     @property
     def trace_layout(self):
@@ -319,6 +328,27 @@ class StoreState:
     tr_idx: jnp.ndarray
     tr_pos: jnp.ndarray
     tr_wm: jnp.ndarray
+    # Middle-host trust: annotation/binary index entries are written
+    # under a span's (min, max) annotation-host pair, so a span whose
+    # annotations span 3+ DISTINCT host services is never indexed under
+    # its middle hosts. ann_poison[s] is the max span gid that had
+    # service s as a middle host; annotation-family fast paths for s are
+    # trusted only once that span is evicted (gid < write_pos -
+    # capacity) — the same displaced-gid gate as tr_wm, self-healing as
+    # the ring turns over.
+    ann_poison: jnp.ndarray  # [S] i64, I64_MIN = never poisoned
+    # Per-key cursor table (the device rendition of Cassandra's per-key
+    # index rows, cassandra-schema.txt:4-8): open addressing keyed by
+    # the candidate families' verify word. key_wm[slot] is the max span
+    # gid of an entry ever DISPLACED from the key's bucket window; a
+    # query whose key record shows key_wm < write_pos - capacity holds
+    # every RESIDENT entry of that key in the bucket window — complete
+    # even when bucket-mates wrapped the bucket (the sparse-key aliasing
+    # fallback of NOTES_r03 §4). Claim-on-empty ONLY, never stolen: an
+    # absent record (congestion) degrades to the per-bucket gates, never
+    # to a wrong answer.
+    key_tab: jnp.ndarray  # [T] i64 — (key48 << 16) | 1; _TAB_EMPTY empty
+    key_wm: jnp.ndarray  # [T] i64 — max displaced gid; I64_MIN none
     svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
     svc_span_counts: jnp.ndarray  # [S] f32
     ann_svc_counts: jnp.ndarray  # [S] f32 — services seen on any annotation
@@ -343,6 +373,7 @@ class StoreState:
         "dep_bank_seq", "dep_window", "dep_window_ts", "span_tab",
         "pend_key", "pend_dur", "pend_tsf", "pend_tsl", "pend_pos",
         "cand_idx", "cand_pos", "cand_wm", "tr_idx", "tr_pos", "tr_wm",
+        "ann_poison", "key_tab", "key_wm",
         "svc_hist", "svc_span_counts", "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
         "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
@@ -407,7 +438,7 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         dep_bank_seq=jnp.int64(0),
         dep_window=jnp.zeros((S * S, M.N_FIELDS), jnp.float32),
         dep_window_ts=jnp.array([I64_MAX, I64_MIN], jnp.int64),
-        span_tab=jnp.zeros(c.tab_slots, jnp.int64),
+        span_tab=jnp.full(c.tab_slots, _TAB_EMPTY, jnp.int64),
         pend_key=jnp.zeros(c.pending_slots, jnp.int64),
         pend_dur=jnp.zeros(c.pending_slots, jnp.int64),
         pend_tsf=jnp.zeros(c.pending_slots, jnp.int64),
@@ -419,6 +450,9 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         tr_idx=jnp.full(c.trace_layout[2], -1, jnp.int64),
         tr_pos=jnp.zeros(c.trace_layout[1], jnp.int64),
         tr_wm=jnp.full(c.trace_layout[1], I64_MIN, jnp.int64),
+        ann_poison=jnp.full(S, I64_MIN, jnp.int64),
+        key_tab=jnp.full(c.key_slots, _TAB_EMPTY, jnp.int64),
+        key_wm=jnp.full(c.key_slots, I64_MIN, jnp.int64),
         svc_hist=Q.init(
             shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
             dtype=jnp.int32,
@@ -631,8 +665,15 @@ def _mix48(a, b):
     return mix_keys64([a, b]) >> jnp.uint64(16)
 
 
+# Empty span-table sentinel: I64_MAX, so a plain scatter-MIN both fills
+# empty slots and arbitrates every in-batch race deterministically (see
+# _tab_insert). A packed word can never equal it: svc is clipped below
+# the full 15-bit mask, so the low 16 bits are never all-ones.
+_TAB_EMPTY = (1 << 63) - 1
+
+
 def _tab_pack(key48, svc):
-    """(key48, service) → occupied table word (never 0)."""
+    """(key48, service) → occupied table word (never _TAB_EMPTY)."""
     s = (jnp.clip(svc, -1, _SVC_MASK - 2) + 1).astype(jnp.uint64)
     return ((key48 << jnp.uint64(16)) | (s << jnp.uint64(1))
             | jnp.uint64(1)).astype(jnp.int64)
@@ -656,7 +697,8 @@ def _tab_lookup(tab, key48):
     svc = jnp.full(key48.shape, -1, jnp.int32)
     for slot in _tab_slots(key48, tab.shape[0]):
         cur = tab[slot].astype(jnp.uint64)
-        hit = ((cur & jnp.uint64(1)) == 1) & ((cur >> jnp.uint64(16)) == key48)
+        hit = (cur != jnp.uint64(_TAB_EMPTY)) & (
+            (cur >> jnp.uint64(16)) == key48)
         first = hit & ~found
         svc = jnp.where(
             first,
@@ -670,29 +712,39 @@ def _tab_lookup(tab, key48):
 
 
 def _tab_insert(tab, key48, svc, valid):
-    """Insert (key48 → svc) rows. Scatter-verify-retry per probe round:
-    two batch rows racing for one empty slot resolve deterministically
-    (the scatter's loser fails the read-back verify and retries its next
-    probe), so a key is only ever lost when all probes land on slots
-    occupied by foreign keys — then the last slot is stolen
-    (random-replacement eviction; the table outlives ring retention,
-    bounded like the reference's index TTL, CassieSpanStore.scala:48)."""
+    """Insert (key48 → svc) rows. Each probe round is ONE scatter-MIN:
+    the empty sentinel (_TAB_EMPTY = I64_MAX) loses to every packed
+    word, and rows racing for one slot resolve to the numerically
+    smallest word — so the client and server halves of an RPC, which
+    share (trace_id, span_id), deterministically keep the LOWEST
+    service id regardless of arrival order, in-batch or across batches.
+    (The reference merges the halves before joining and picks one
+    serviceName, ZipkinAggregateJob.scala mergeSpan; min-service-id is
+    this store's deterministic analogue — divergence noted in
+    COVERAGE.md row 3.) A different-key loser fails the read-back
+    verify and retries its next probe; a key is only ever lost when all
+    probes land on slots occupied by foreign keys — then the last slot
+    is stolen (random-replacement eviction; the table outlives ring
+    retention, bounded like the reference's index TTL,
+    CassieSpanStore.scala:48)."""
     oob = tab.shape[0]
     packed = _tab_pack(key48, svc)
     placed = ~jnp.asarray(valid, bool)
     slots = _tab_slots(key48, tab.shape[0])
     for slot in slots:
         cur = tab[slot].astype(jnp.uint64)
-        open_ = ((cur & jnp.uint64(1)) == 0) | (
+        open_ = (cur == jnp.uint64(_TAB_EMPTY)) | (
             (cur >> jnp.uint64(16)) == key48
         )
         attempt = ~placed & open_
-        tab = tab.at[jnp.where(attempt, slot, oob)].set(packed, mode="drop")
+        tab = tab.at[jnp.where(attempt, slot, oob)].min(packed, mode="drop")
         after = tab[slot].astype(jnp.uint64)
         placed |= attempt & ((after >> jnp.uint64(16)) == key48)
-    return tab.at[jnp.where(placed, oob, slots[-1])].set(
-        packed, mode="drop"
-    )
+    # Last-resort steal: clear, then MIN — so even same-slot stealers
+    # tie-break deterministically instead of by scatter order.
+    steal = jnp.where(placed, oob, slots[-1])
+    tab = tab.at[steal].set(jnp.int64(_TAB_EMPTY), mode="drop")
+    return tab.at[steal].min(packed, mode="drop")
 
 
 # -- index column families ---------------------------------------------------
@@ -707,17 +759,24 @@ def _tab_insert(tab, key48, svc, valid):
 # needs no index maintenance.
 
 
-def _fifo_ranks(bucket, valid):
+def _fifo_ranks(bucket, valid, n_buckets: int):
     """Arrival-order rank of each row within its bucket. One stable
     single-key sort (bucket in the high bits, row index in the low bits)
     + a cummax segment-start fill — deterministic, so two ingests of the
-    same batch produce bitwise-identical index state."""
+    same batch produce bitwise-identical index state.
+
+    The shift is derived from the (static) row count, so an
+    annotation-heavy launch past 2^21 concatenated rows widens the key
+    instead of tripping an assert; the static bucket-count bound keeps
+    the sentinel (one past every real bucket id, 2^62 after shifting)
+    from wrapping sign."""
     n = bucket.shape[0]
-    assert n < (1 << 21), "index write exceeds rank key space"
-    # Sentinel must survive the << 21 without wrapping sign: 2^41 keys
-    # after every real bucket id (buckets < 2^21), 2^62 after shifting.
-    key = jnp.where(valid, bucket.astype(jnp.int64), jnp.int64(1) << 41)
-    skey = (key << 21) | jnp.arange(n, dtype=jnp.int64)
+    shift = max((n - 1).bit_length(), 1)
+    assert n_buckets < (1 << (62 - shift)), (
+        f"rank key space exhausted: {n} rows x {n_buckets} buckets")
+    key = jnp.where(valid, bucket.astype(jnp.int64),
+                    jnp.int64(1) << (62 - shift))
+    skey = (key << shift) | jnp.arange(n, dtype=jnp.int64)
     order = jnp.argsort(skey)
     sk = key[order]
     first = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
@@ -726,8 +785,8 @@ def _fifo_ranks(bucket, valid):
     return jnp.zeros(n, jnp.int32).at[order].set(idxs - start)
 
 
-def _index_write(entries, pos, wm, gbucket, slot0, depth, gid, verify,
-                 ts, valid):
+def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
+                 depth, gid, verify, ts, valid, keyed):
     """ONE combined append of (gid, verify, ts) rows into the unified
     candidate-family entry array: ``gbucket`` is the global bucket id
     (addressing pos/wm), ``slot0`` the bucket's first entry row, and
@@ -740,9 +799,14 @@ def _index_write(entries, pos, wm, gbucket, slot0, depth, gid, verify,
     displaced (by wraparound, or by in-batch overflow where one launch
     writes more than ``depth`` rows to a bucket and keeps the newest).
     Queries on a wrapped bucket are exact iff their last returned
-    candidate still ranks >= the watermark."""
+    candidate still ranks >= the watermark.
+
+    ``key_tab``/``key_wm`` is the per-key cursor table (see
+    StoreState.key_tab); rows with ``keyed`` claim a record for their
+    verify word, and every displaced or in-batch-dropped keyed entry
+    scatter-maxes its span gid into its key's displaced watermark."""
     n_b = pos.shape[0]
-    rank = _fifo_ranks(gbucket, valid)
+    rank = _fifo_ranks(gbucket, valid, n_b)
     b_c = jnp.clip(gbucket, 0, n_b - 1)
     oob_b = jnp.where(valid, b_c, n_b)
     cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
@@ -755,14 +819,57 @@ def _index_write(entries, pos, wm, gbucket, slot0, depth, gid, verify,
     dropped_ts = jnp.where(valid & ~keep, jnp.asarray(ts, jnp.int64),
                            I64_MIN)
     wm = wm.at[oob_b].max(jnp.maximum(old_ts, dropped_ts), mode="drop")
-    vals = jnp.stack(
-        [jnp.asarray(gid, jnp.int64), jnp.asarray(verify, jnp.int64),
-         jnp.asarray(ts, jnp.int64)],
-        axis=-1,
-    )
+    gid = jnp.asarray(gid, jnp.int64)
+    verify = jnp.asarray(verify, jnp.int64)
+    vals = jnp.stack([gid, verify, jnp.asarray(ts, jnp.int64)], axis=-1)
     entries = entries.at[idx].set(vals, mode="drop")
     pos = pos.at[oob_b].add(1, mode="drop")
-    return entries, pos, wm
+
+    # -- per-key cursor table ------------------------------------------
+    # 1. Claim records for this batch's keys: empty slots only, scatter-
+    #    MIN arbitration (all contenders for a slot resolve to the
+    #    numerically smallest word, deterministically). NEVER stolen and
+    #    never seeded on occupied-by-foreign probes: a key that fails
+    #    every probe simply has no record, which queries treat as
+    #    "unknown — use the bucket gates". Claim-with-clean-watermark is
+    #    sound precisely because records are immortal: a key that ever
+    #    failed to claim keeps failing (slots only fill), so a fresh
+    #    claim really is the key's first record.
+    T = key_tab.shape[0]
+    ins_ok = valid & jnp.asarray(keyed, bool)
+    k48n = verify.astype(jnp.uint64) >> jnp.uint64(16)
+    packed = ((k48n << jnp.uint64(16)) | jnp.uint64(1)).astype(jnp.int64)
+    placed = ~ins_ok
+    for kslot in _tab_slots(k48n, T):
+        cur = key_tab[kslot].astype(jnp.uint64)
+        open_ = (cur == jnp.uint64(_TAB_EMPTY)) | (
+            (cur >> jnp.uint64(16)) == k48n
+        )
+        attempt = ~placed & open_
+        key_tab = key_tab.at[jnp.where(attempt, kslot, T)].min(
+            packed, mode="drop"
+        )
+        after = key_tab[kslot].astype(jnp.uint64)
+        placed |= attempt & ((after >> jnp.uint64(16)) == k48n)
+    # 2. Record displacements: bucket-wrap victims carry their OLD
+    #    entry's (verify, gid); in-batch overflow drops carry their own.
+    disp_ok = jnp.asarray(keyed, bool) & (
+        (keep & (old[:, 0] >= 0)) | (valid & ~keep)
+    )
+    disp_key = jnp.where(keep, old[:, 1], verify)
+    disp_gid = jnp.where(keep, old[:, 0], gid)
+    k48d = disp_key.astype(jnp.uint64) >> jnp.uint64(16)
+    seen = jnp.zeros(k48d.shape, bool)
+    for kslot in _tab_slots(k48d, T):
+        cur = key_tab[kslot].astype(jnp.uint64)
+        hit = disp_ok & ~seen & (cur != jnp.uint64(_TAB_EMPTY)) & (
+            (cur >> jnp.uint64(16)) == k48d
+        )
+        key_wm = key_wm.at[jnp.where(hit, kslot, T)].max(
+            disp_gid, mode="drop"
+        )
+        seen |= hit
+    return entries, pos, wm, key_tab, key_wm
 
 
 def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
@@ -776,7 +883,7 @@ def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
     family keeps its own gate false forever, which the scan fallback
     covers."""
     n_b = pos.shape[0]
-    rank = _fifo_ranks(gbucket, valid)
+    rank = _fifo_ranks(gbucket, valid, n_b)
     b_c = jnp.clip(gbucket, 0, n_b - 1)
     oob_b = jnp.where(valid, b_c, n_b)
     cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
@@ -973,6 +1080,35 @@ def poison_index_trust(state: "StoreState") -> "StoreState":
     return state.replace(**upd)
 
 
+def poison_ann_trust(state: "StoreState") -> "StoreState":
+    """Trust reset for snapshots predating revision 7, covering both
+    rev-7 additions. Works on single and stacked sharded states alike.
+
+    - ``ann_poison`` didn't exist: any restored resident span might
+      have 3+ distinct annotation hosts, so stamp every service with
+      the current write_pos — the annotation-family fast paths distrust
+      their buckets until the ring has fully turned over, then
+      self-heal.
+    - ``key_tab`` didn't exist: the claim-with-clean-watermark
+      invariant ("a fresh claim is the key's first record ever") does
+      NOT hold across the restore boundary — pre-restore displacement
+      history is lost, so a post-restore claim could certify a window
+      missing displaced-but-resident restored spans. Permanently
+      disable the table with a tombstone word (I64_MIN: scatter-MIN can
+      never overwrite it, so claims always fail → absent records →
+      bucket gates serve, exactly the pre-upgrade behavior); key_wm is
+      pinned at I64_MAX so even a 2^-48 key48 collision with the
+      tombstone pattern reads as untrusted."""
+    wp = jnp.asarray(state.write_pos, jnp.int64)
+    return state.replace(
+        ann_poison=jnp.broadcast_to(
+            wp[..., None], state.ann_poison.shape
+        ).astype(jnp.int64),
+        key_tab=jnp.full(state.key_tab.shape, I64_MIN, jnp.int64),
+        key_wm=jnp.full(state.key_wm.shape, I64_MAX, jnp.int64),
+    )
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def rebuild_span_tab(state: "StoreState") -> "StoreState":
     """(Re)insert every live resident span into the hash table. Used
@@ -1148,7 +1284,10 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
 
         def seg(fam, local_bucket, gid, verify, ts, ok):
             """One concatenation segment of the combined write: global
-            bucket, first-slot row, depth vectors + the entry payload."""
+            bucket, first-slot row, depth vectors + the entry payload.
+            The service family is not per-key-tracked (its bucket IS the
+            key — no aliasing — and its verify words are raw service ids
+            whose key48 would all collide)."""
             b_base, s_base, n_b, depth = lay[fam]
             lb = jnp.clip(local_bucket, 0, n_b - 1)
             n = lb.shape[0]
@@ -1160,6 +1299,7 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
                 jnp.asarray(verify, jnp.int64),
                 jnp.asarray(ts, jnp.int64),
                 ok,
+                jnp.full(n, fam != StoreConfig.CAND_SVC, bool),
             )
 
         segments = []
@@ -1184,6 +1324,16 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         hmin, hmax = _span_host_range(a_host, b.ann_span_idx, a_idx_ok, P)
         h1 = hmin[b.ann_span_idx]
         h2 = hmax[b.ann_span_idx]
+        # A 3+-distinct-host span is indexed under (min, max) only: its
+        # MIDDLE hosts' annotation-family buckets could claim complete
+        # answers that silently omit it. Record the span's gid against
+        # each middle host; queries for that service distrust the
+        # annotation fast paths until the span is evicted (see
+        # StoreState.ann_poison).
+        mid = a_idx_ok & (a_host != h1) & (a_host != h2)
+        upd["ann_poison"] = state.ann_poison.at[
+            jnp.where(mid, a_host, S)
+        ].max(jnp.where(mid, span_gid_of_ann, I64_MIN), mode="drop")
         v_ok = (
             mask_a & (b.ann_value_id >= FIRST_USER_ANNOTATION_ID)
             & (b.ann_value_id < jnp.int32(1 << 30))
@@ -1220,8 +1370,10 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
                 ts_b, ok,
             ))
         cat = [jnp.concatenate(parts) for parts in zip(*segments)]
-        upd["cand_idx"], upd["cand_pos"], upd["cand_wm"] = _index_write(
-            state.cand_idx, state.cand_pos, state.cand_wm, *cat
+        (upd["cand_idx"], upd["cand_pos"], upd["cand_wm"],
+         upd["key_tab"], upd["key_wm"]) = _index_write(
+            state.cand_idx, state.cand_pos, state.cand_wm,
+            state.key_tab, state.key_wm, *cat
         )
         # Trace-membership family: row gids bucketed by trace-id hash,
         # one sub-family per ring (whole-trace fetch + durations).
@@ -1342,6 +1494,39 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     }
 
     return state.replace(**upd)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def ingest_steps(state: StoreState, stacked: DeviceBatch) -> StoreState:
+    """Chained ingest: run one fused step per leading-axis slice of
+    ``stacked`` (a DeviceBatch whose every array carries a [k, ...]
+    batch axis) inside a single jitted launch.
+
+    On this backend one jitted CALL costs ~90-110 ms of dispatch
+    regardless of work, while a ``lax.scan`` iteration costs ~5-7 ms
+    (NOTES_r03.md §3) — so landing k batches per launch divides the
+    per-batch dispatch floor by ~k. This is the device analogue of the
+    reference collector draining several ItemQueue items per worker
+    wake-up (ItemQueue.scala:39): amortize the fixed per-dispatch cost
+    over many queued batches. Chunk boundaries, ring-capacity guards,
+    and the sweep cadence are the CALLER's job, exactly as for
+    ingest_step; every slice must satisfy the same capacity bounds."""
+    state, _ = jax.lax.scan(
+        lambda st, db: (ingest_step.__wrapped__(st, db), None),
+        state, stacked,
+    )
+    return state
+
+
+def stack_device_batches(dbs) -> DeviceBatch:
+    """Stack equal-shape DeviceBatches along a new leading axis for
+    ingest_steps (host-side; numpy arrays in, one stacked batch out)."""
+    import numpy as np
+
+    return DeviceBatch(*(
+        np.stack([np.asarray(getattr(db, f)) for db in dbs])
+        for f in DeviceBatch._fields
+    ))
 
 
 # ---------------------------------------------------------------------------
@@ -1520,10 +1705,28 @@ def _iq_service_impl(entries, pos, wm, row_gid, indexable, trace_id,
                       trace_id, ok, capacity, depth, k, end_ts)
 
 
+def _key_lookup_wm(key_tab, key_wm, mixed):
+    """Per-key cursor lookup (see StoreState.key_tab): (record found,
+    max displaced gid) for the query key's verify word. Works on scalar
+    or [N]-vector ``mixed``."""
+    T = key_tab.shape[0]
+    k48 = mixed >> jnp.uint64(16)
+    found = jnp.zeros(jnp.shape(k48), bool)
+    wmv = jnp.full(jnp.shape(k48), I64_MIN, jnp.int64)
+    for slot in _tab_slots(k48, T):
+        cur = key_tab[slot].astype(jnp.uint64)
+        hit = (cur != jnp.uint64(_TAB_EMPTY)) & (
+            (cur >> jnp.uint64(16)) == k48)
+        wmv = jnp.where(hit & ~found, key_wm[slot], wmv)
+        found |= hit
+    return found, wmv
+
+
 @partial(jax.jit, static_argnums=(7, 8, 9))
 def _iq_verify_impl(entries, pos, wm, row_gid, indexable, trace_id,
                     ts_last, capacity: int, layout, k: int,
-                    key_parts, end_ts):
+                    key_parts, end_ts, key_tab, key_wm, write_pos,
+                    poison=None):
     b_base, s_base, n_b, depth = layout
     mixed = _mixb(list(key_parts))
     lb = _bucket_of(mixed, n_b)
@@ -1533,14 +1736,34 @@ def _iq_verify_impl(entries, pos, wm, row_gid, indexable, trace_id,
     )
     gb = jnp.int32(b_base) + lb
     ver_ok = row[:, 1] == _verify_of(mixed)
-    return _iq_finish(row, pos[gb], wm[gb], row_gid, indexable, ts_last,
-                      trace_id, ver_ok, capacity, depth, k, end_ts)
+    cnt, bwm = pos[gb], wm[gb]
+    # Per-key completeness: every entry this key ever LOST from its
+    # bucket is already evicted from the ring, so the verify-matched
+    # window rows are the key's full resident entry set — exact even
+    # when bucket-mates wrapped the bucket.
+    kfound, kwmv = _key_lookup_wm(key_tab, key_wm, mixed)
+    key_complete = kfound & (kwmv < write_pos - capacity)
+    if poison is not None:
+        # Middle-host distrust (see StoreState.ann_poison): while a
+        # 3+-distinct-host span with key_parts[0] as a middle host is
+        # still resident, no completeness claim may be trusted.
+        svc = jnp.clip(key_parts[0], 0, poison.shape[0] - 1)
+        bad = poison[svc] >= write_pos - capacity
+        cnt = jnp.where(bad, jnp.int64(depth + 1), cnt)
+        bwm = jnp.where(bad, jnp.int64(I64_MAX), bwm)
+        key_complete &= ~bad
+    mat, complete, out_wm = _iq_finish(
+        row, cnt, bwm, row_gid, indexable, ts_last, trace_id, ver_ok,
+        capacity, depth, k, end_ts,
+    )
+    return mat, complete | key_complete, out_wm
 
 
 @partial(jax.jit, static_argnums=(7, 8, 9))
 def _iq_verify2_impl(entries, pos, wm, row_gid, indexable, trace_id,
                      ts_last, capacity: int, layout, k: int,
-                     key_parts1, key_parts2, end_ts):
+                     key_parts1, key_parts2, end_ts,
+                     key_tab, key_wm, write_pos, poison=None):
     b_base, s_base, n_b, depth = layout
     m1 = _mixb(list(key_parts1))
     m2 = _mixb(list(key_parts2))
@@ -1558,10 +1781,117 @@ def _iq_verify2_impl(entries, pos, wm, row_gid, indexable, trace_id,
     gb1 = jnp.int32(b_base) + lb1
     gb2 = jnp.int32(b_base) + lb2
     cnt = jnp.maximum(pos[gb1], pos[gb2])
+    bwm = jnp.maximum(wm[gb1], wm[gb2])
+    # Candidates span BOTH buckets, so per-key completeness needs both
+    # keys' records to pass the displaced-gid gate.
+    kf1, kw1 = _key_lookup_wm(key_tab, key_wm, m1)
+    kf2, kw2 = _key_lookup_wm(key_tab, key_wm, m2)
+    horizon = write_pos - capacity
+    key_complete = kf1 & kf2 & (kw1 < horizon) & (kw2 < horizon)
+    if poison is not None:
+        svc = jnp.clip(key_parts1[0], 0, poison.shape[0] - 1)
+        bad = poison[svc] >= horizon
+        cnt = jnp.where(bad, jnp.int64(depth + 1), cnt)
+        bwm = jnp.where(bad, jnp.int64(I64_MAX), bwm)
+        key_complete &= ~bad
     ver_ok = (row[:, 1] == _verify_of(m1)) | (row[:, 1] == _verify_of(m2))
-    return _iq_finish(row, cnt, jnp.maximum(wm[gb1], wm[gb2]), row_gid,
-                      indexable, ts_last, trace_id, ver_ok, capacity,
-                      depth, k, end_ts)
+    mat, complete, out_wm = _iq_finish(
+        row, cnt, bwm, row_gid, indexable, ts_last, trace_id, ver_ok,
+        capacity, depth, k, end_ts,
+    )
+    return mat, complete | key_complete, out_wm
+
+
+@partial(jax.jit, static_argnums=(7, 8, 9))
+def _iq_multi_impl(entries, pos, wm, row_gid, indexable, trace_id,
+                   ts_last, capacity: int, k: int, k_max: int,
+                   b_base, s_base, n_b, depth,
+                   key1, key2, key3, three, is_svc,
+                   end_ts, poison_on, poison, write_pos,
+                   key_tab, key_wm):
+    """N independent index-bucket probes in ONE launch.
+
+    Every probe carries its own family geometry (b_base/s_base/n_b/
+    depth, rows of config.cand_layout) and key parts as DATA, so one
+    compiled kernel serves any mix of service / (service, span-name) /
+    (service, annotation-value) / (service, binary-key[, value]) probes.
+    On this backend a jitted call costs ~90-110 ms flat (NOTES_r03 §3);
+    the reference pays one index read per slice of a query
+    (ThriftQueryService.scala:166-196) — this folds all slices (and all
+    queries of a batch) into a single dispatch. Returns ([N, 3, k]
+    candidates, [N] complete, [N] watermark) with the same trust
+    contract as _iq_verify_impl; ``k_max`` is the widest family depth
+    (static pad for the per-probe bucket windows).
+
+    - ``three``: probe keys are (key1, key2, key3) instead of (key1,
+      key2) — the binary families mix three parts.
+    - ``is_svc``: service-family probe; the bucket is key1 itself and
+      entry verify words equal the host service id.
+    - ``poison_on``: apply the middle-host ann_poison gate (see
+      StoreState.ann_poison) with key1 as the service id.
+    """
+    m2 = _mixb([key1, key2])
+    m3 = _mixb([key1, key2, key3])
+    mixed = jnp.where(three, m3, m2)
+    nb64 = n_b.astype(jnp.int64)
+    lb = (mixed & (nb64 - 1).astype(jnp.uint64)).astype(jnp.int64)
+    lb = jnp.where(is_svc, jnp.clip(key1.astype(jnp.int64), 0, nb64 - 1),
+                   lb)
+    gb = b_base + lb
+    slot0 = s_base + lb * depth.astype(jnp.int64)
+    rows = jnp.arange(k_max, dtype=jnp.int64)[None, :]
+    valid_row = rows < depth[:, None]
+    idx = jnp.where(valid_row, slot0[:, None] + rows, entries.shape[0])
+    eg = entries[jnp.clip(idx, 0, entries.shape[0] - 1)]  # [N, Kmax, 3]
+    exp_ver = jnp.where(is_svc, key1.astype(jnp.int64), _verify_of(mixed))
+    ver_ok = valid_row & (eg[:, :, 1] == exp_ver[:, None])
+    gid = eg[:, :, 0]
+    slot = jnp.clip((gid % capacity).astype(jnp.int32), 0, capacity - 1)
+    live = (gid >= 0) & (row_gid[slot] == gid)
+    ok = live & indexable[slot] & ver_ok
+    ts = ts_last[slot]
+    ok &= (ts >= 0) & (ts <= end_ts[:, None])
+    mat = jax.vmap(
+        lambda t, s, o: _topk_candidates(t, s, o, k)
+    )(trace_id[slot], ts, ok)
+    cnt = pos[jnp.clip(gb, 0, pos.shape[0] - 1)]
+    wmv = wm[jnp.clip(gb, 0, wm.shape[0] - 1)]
+    horizon = write_pos - capacity
+    bad = poison_on & (
+        poison[jnp.clip(key1, 0, poison.shape[0] - 1)] >= horizon
+    )
+    cnt = jnp.where(bad, depth.astype(jnp.int64) + 1, cnt)
+    wmv = jnp.where(bad, jnp.int64(I64_MAX), wmv)
+    kfound, kwmv = _key_lookup_wm(key_tab, key_wm, mixed)
+    key_complete = ~is_svc & ~bad & kfound & (kwmv < horizon)
+    return mat, (cnt <= depth) | key_complete, wmv
+
+
+def iquery_trace_ids_multi(state: StoreState, probes, k: int):
+    """Host wrapper for _iq_multi_impl: ``probes`` is a dict of equal-
+    length numpy arrays (keys matching the kernel's probe operands).
+    Returns device results ([N, 3, k], [N] complete, [N] wm)."""
+    c = state.config
+    k_max = max(fam[3] for fam in c.cand_layout[0])
+    k = min(k, k_max)
+    return _iq_multi_impl(
+        state.cand_idx, state.cand_pos, state.cand_wm, state.row_gid,
+        state.indexable, state.trace_id, state.ts_last,
+        c.capacity, k, k_max,
+        jnp.asarray(probes["b_base"], jnp.int64),
+        jnp.asarray(probes["s_base"], jnp.int64),
+        jnp.asarray(probes["n_b"], jnp.int64),
+        jnp.asarray(probes["depth"], jnp.int64),
+        jnp.asarray(probes["key1"], jnp.int32),
+        jnp.asarray(probes["key2"], jnp.int32),
+        jnp.asarray(probes["key3"], jnp.int32),
+        jnp.asarray(probes["three"], bool),
+        jnp.asarray(probes["is_svc"], bool),
+        jnp.asarray(probes["end_ts"], jnp.int64),
+        jnp.asarray(probes["poison_on"], bool),
+        state.ann_poison, state.write_pos,
+        state.key_tab, state.key_wm,
+    )
 
 
 def iquery_trace_ids_by_service(state: StoreState, svc_id, name_lc_id,
@@ -1580,6 +1910,7 @@ def iquery_trace_ids_by_service(state: StoreState, svc_id, name_lc_id,
             state.row_gid, state.indexable, state.trace_id, state.ts_last,
             c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(name_lc_id)), end_ts,
+            state.key_tab, state.key_wm, state.write_pos,
         )
     fam = lay[StoreConfig.CAND_SVC]
     return _iq_service_impl(
@@ -1604,6 +1935,8 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
             state.row_gid, state.indexable, state.trace_id, state.ts_last,
             c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(ann_value_id)), end_ts,
+            state.key_tab, state.key_wm, state.write_pos,
+            state.ann_poison,
         )
     if bann_value_id is None or bann_value_id < 0:
         bann_value_id = -1
@@ -1623,17 +1956,23 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
             state.row_gid, state.indexable, state.trace_id, state.ts_last,
             c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(bann_key_id), jnp.int32(-1)),
-            end_ts,
+            end_ts, state.key_tab, state.key_wm, state.write_pos,
+            state.ann_poison,
         )
+    # The two-bucket probe's candidate window is 2*depth rows; clamping
+    # k to depth would truncate valid candidates of never-wrapped
+    # buckets and let the host's underfull-equals-complete gate trust a
+    # silently cut window (caught by the 3-store oracle parity drive).
     return _iq_verify2_impl(
         state.cand_idx, state.cand_pos, state.cand_wm,
         state.row_gid, state.indexable, state.trace_id, state.ts_last,
-        c.capacity, fam, min(k, fam[3]),
+        c.capacity, fam, min(k, 2 * fam[3]),
         (jnp.int32(svc_id), jnp.int32(bann_key_id),
          jnp.int32(bann_value_id)),
         (jnp.int32(svc_id), jnp.int32(bann_key_id),
          jnp.int32(bann_value_id2)),
-        end_ts,
+        end_ts, state.key_tab, state.key_wm, state.write_pos,
+        state.ann_poison,
     )
 
 

@@ -46,6 +46,7 @@ from zipkin_tpu.store.base import (
     fill_pin,
     gather_with_escalation,
     index_first_topk,
+    index_topk_or_none,
     prune_ttls,
     resolve_annotation_query,
     should_index,
@@ -159,6 +160,12 @@ class TpuSpanStore(SpanStore):
         # a ring's capacity (the maxTraceCols-style guard).
         self.anns_truncated = 0
         self.banns_truncated = 0
+        # Index read-path outcome counters (surfaced via counters() →
+        # /metrics): how often the fast path answered vs degraded to the
+        # O(ring) scan kernels — the observable for the sparse-key
+        # aliasing rate the per-key cursor table exists to shrink.
+        self.index_hits = 0
+        self.index_fallbacks = 0
         # name_id -> lowercased-name id, maintained incrementally.
         self._name_lc: Dict[int, int] = {}
 
@@ -191,17 +198,27 @@ class TpuSpanStore(SpanStore):
             # slots); trace grouping just keeps each trace's rows
             # adjacent in the ring. _chunk_columnar additionally guards
             # the annotation rings (one fat span's rows get truncated,
-            # not the whole batch dropped).
+            # not the whole batch dropped). Multiple chunks chain into
+            # one launch (_write_parts) to amortize the per-dispatch
+            # floor.
+            # Buffer at most one chain group (+ one trace chunk's worth)
+            # of encoded columnar parts — a bulk apply() must not hold
+            # the whole call's columnar copy in host memory at once.
+            parts = []
             for part in self._chunk_by_trace(spans):
                 batch = self.codec.encode(part)
                 indexable = np.fromiter(
                     (should_index(s) for s in part), bool, len(part)
                 )
                 name_lc = self._name_lc_ids(batch)
-                for cb, clc, cix in self._chunk_columnar(
+                parts.extend(self._chunk_columnar(
                     batch, name_lc, indexable
-                ):
-                    self._write_device(cb, clc, cix)
+                ))
+                if self.CHAIN_SIZES and len(parts) >= self.CHAIN_SIZES[0]:
+                    self._write_parts(parts)
+                    parts = []
+            if parts:
+                self._write_parts(parts)
 
     def _chunk_by_trace(self, spans: Sequence[Span]):
         chunk_size = self._max_chunk_spans()
@@ -277,10 +294,9 @@ class TpuSpanStore(SpanStore):
                     )
             self._prune_ttls()
             indexable = native.indexable_from_batch(batch, self.dicts)
-            for part, part_lc, part_ix in self._chunk_columnar(
+            self._write_parts(list(self._chunk_columnar(
                 batch, name_lc, indexable
-            ):
-                self._write_device(part, part_lc, part_ix)
+            )))
             return batch.n_spans, dropped, kept_debug
 
     def _chunk_columnar(self, batch: SpanBatch, name_lc: np.ndarray,
@@ -396,6 +412,62 @@ class TpuSpanStore(SpanStore):
             )
         self._write_device(batch, self._name_lc_ids(batch), indexable)
 
+    # Chained-launch grouping: chunks per ingest_steps launch. Powers of
+    # two only ({4, 8, 16}) so the scan length doesn't fragment the
+    # compile cache; leftovers run singly.
+    CHAIN_SIZES = (16, 8, 4)
+
+    def _write_parts(self, parts) -> None:
+        """Write a list of (batch, name_lc, indexable) chunks, chaining
+        groups of equal-padded chunks into single ``dev.ingest_steps``
+        launches — one ~100ms dispatch per GROUP instead of per chunk
+        (NOTES_r03 §3 cost model; the ItemQueue batch-drain role,
+        ItemQueue.scala:39). Groups are bounded by capacity//2 spans so
+        the archive cadence (one dependency-bucket close per half ring)
+        can never be outrun inside one launch."""
+        span_budget = max(1, self.config.capacity // 2)
+        i = 0
+        n = len(parts)
+        while i < n:
+            took = 1
+            for size in self.CHAIN_SIZES:
+                if i + size > n:
+                    continue
+                group = parts[i:i + size]
+                if sum(p[0].n_spans for p in group) <= span_budget:
+                    self._write_device_many(group)
+                    took = size
+                    break
+            else:
+                self._write_device(*parts[i])
+            i += took
+
+    def _write_device_many(self, group) -> None:
+        """One chained launch over ≥2 chunks: pad every chunk to the
+        group's max shapes, stack, and scan (dev.ingest_steps). Each
+        chunk individually satisfies the ring-capacity guards, and scan
+        steps run sequentially, so per-launch invariants match the
+        single-chunk path's."""
+        pad_s = _next_pow2(max(b.n_spans for b, _, _ in group))
+        pad_a = _next_pow2(max(b.n_annotations for b, _, _ in group))
+        pad_b = _next_pow2(max(b.n_binary for b, _, _ in group))
+        dbs = [
+            dev.make_device_batch(
+                b, name_lc_id=lc, indexable=ix,
+                pad_spans=pad_s, pad_anns=pad_a, pad_banns=pad_b,
+            )
+            for b, lc, ix in group
+        ]
+        stacked = dev.stack_device_batches(dbs)
+        total = sum(b.n_spans for b, _, _ in group)
+        self._maybe_archive(total)
+        with self._rw.write():
+            self.state = dev.ingest_steps(self.state, stacked)
+        self._wp += total
+        self._batches_since_sweep += len(group)
+        if self._batches_since_sweep >= self.SWEEP_EVERY:
+            self._sweep_pending()
+
     def _write_device(self, batch: SpanBatch, name_lc: np.ndarray,
                       indexable: np.ndarray) -> None:
         """Pad, upload, and run the fused ingest step for one chunk that
@@ -443,6 +515,27 @@ class TpuSpanStore(SpanStore):
             self._wp, max(self._wp + incoming - cap, self._wp - cap // 2)
         )
 
+    def adopt_state(self, state, spans_written: int,
+                    archived: Optional[int] = None) -> None:
+        """Adopt a device state produced OUTSIDE the store's write path
+        (e.g. a benchmark streaming dev.ingest_step directly) and re-seed
+        every host-side clock that paces sweeps and bucket rotation:
+
+        - ``spans_written``: total spans ever written into the adopted
+          state (its write_pos) — seeds the archive cadence.
+        - ``archived``: span watermark of the last dependency-bucket
+          close; defaults to ``spans_written`` ("just rotated").
+
+        The sweep clock is marked dirty: the adopted state may carry
+        unresolved pending children, so the first dependency read must
+        run a pending sweep (the streaming-join contract) even though no
+        store-mediated batch was ever written."""
+        with self._rw.write():
+            self.state = state
+        self._wp = int(spans_written)
+        self._archived = self._wp if archived is None else int(archived)
+        self._batches_since_sweep = 1
+
     # TTLs above the per-write default mark a trace pinned: its spans are
     # materialized to the host pin bank so ring eviction can't drop them.
     DEFAULT_TTL_S = 1.0
@@ -469,8 +562,11 @@ class TpuSpanStore(SpanStore):
 
     def get_trace_ids_by_name(
         self, service_name: str, span_name: Optional[str],
-        end_ts: int, limit: int,
+        end_ts: int, limit: int, force_scan: bool = False,
     ) -> List[IndexedTraceId]:
+        """``force_scan`` pins the read to the O(ring) scan kernels —
+        the on-device index-vs-scan exactness harness (bench.py
+        --tpu-exactness) compares both paths on one live store."""
         svc = self._svc_id(service_name)
         if svc is None or limit <= 0:
             return []
@@ -499,19 +595,31 @@ class TpuSpanStore(SpanStore):
                 )
             cands = [(int(t), int(ts))
                      for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
-            return cands, bool(complete), int(wm)
+            return cands, bool(complete), int(wm), mat.shape[1]
 
-        if self.config.use_index:
-            return index_first_topk(
+        if self.config.use_index and not force_scan:
+            return self._index_first(
                 limit, self.config.ann_capacity, index_fetch, fetch
             )
         return topk_ids_with_escalation(
             limit, self.config.ann_capacity, fetch
         )
 
+    def _index_first(self, limit, k_max, index_fetch, scan_fetch):
+        """index_first_topk with hit/fallback accounting (→ /metrics)."""
+        k = limit * 8
+        candidates, complete, wm, window = index_fetch(k)
+        ids = index_topk_or_none(limit, min(k, window), candidates,
+                                 complete, wm)
+        if ids is not None:
+            self.index_hits += 1
+            return ids
+        self.index_fallbacks += 1
+        return topk_ids_with_escalation(limit, k_max, scan_fetch)
+
     def get_trace_ids_by_annotation(
         self, service_name: str, annotation: str, value: Optional[bytes],
-        end_ts: int, limit: int,
+        end_ts: int, limit: int, force_scan: bool = False,
     ) -> List[IndexedTraceId]:
         if annotation in CORE_ANNOTATIONS or limit <= 0:
             return []
@@ -543,7 +651,7 @@ class TpuSpanStore(SpanStore):
                 )
             cands = [(int(t), int(ts))
                      for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
-            return cands, bool(complete), int(wm)
+            return cands, bool(complete), int(wm), mat.shape[1]
 
         c = self.config
         # A name present BOTH as a user-annotation value and as a
@@ -551,14 +659,177 @@ class TpuSpanStore(SpanStore):
         # semantics); the index families are per-side, so the rare
         # mixed case takes the scan.
         mixed = ann_value >= 0 and bann_key >= 0
-        if c.use_index and not mixed:
-            return index_first_topk(
+        if c.use_index and not mixed and not force_scan:
+            return self._index_first(
                 limit, c.ann_capacity + c.bann_capacity, index_fetch,
                 fetch,
             )
         return topk_ids_with_escalation(
             limit, c.ann_capacity + c.bann_capacity, fetch
         )
+
+    def get_trace_ids_multi(self, queries) -> List[List[IndexedTraceId]]:
+        """Batched index read: every query's bucket probe rides ONE
+        kernel launch (dev._iq_multi_impl) instead of one ~100ms
+        dispatch each; only unresolvable dictionary keys, mixed
+        ann/binary names, and distrusted buckets drop to the singular
+        paths. See SpanStore.get_trace_ids_multi for the query format."""
+        c = self.config
+        if not c.use_index or not queries:
+            return super().get_trace_ids_multi(queries)
+        lay, _, _ = c.cand_layout
+        results: List[Optional[List[IndexedTraceId]]] = [None] * len(queries)
+        fallback: List[int] = []
+        probes: List[tuple] = []  # (qi, fam_row, key1, key2, key3,
+        #                            three, is_svc, poison_on, end_ts)
+        limits = [0] * len(queries)
+        for qi, q in enumerate(queries):
+            if q[0] == "name":
+                _, service, span_name, end_ts, limit = q
+                limits[qi] = limit
+                svc = self._svc_id(service)
+                if svc is None or limit <= 0:
+                    results[qi] = []
+                    continue
+                if span_name is not None:
+                    name_lc = self.dicts.span_names.get(span_name.lower())
+                    if name_lc is None:
+                        results[qi] = []
+                        continue
+                    probes.append((qi, lay[dev.StoreConfig.CAND_NAME],
+                                   svc, name_lc, -1, False, False, False,
+                                   end_ts))
+                else:
+                    probes.append((qi, lay[dev.StoreConfig.CAND_SVC],
+                                   svc, -1, -1, False, True, False,
+                                   end_ts))
+            else:
+                _, service, annotation, value, end_ts, limit = q
+                limits[qi] = limit
+                if annotation in CORE_ANNOTATIONS or limit <= 0:
+                    results[qi] = []
+                    continue
+                svc = self._svc_id(service)
+                if svc is None:
+                    results[qi] = []
+                    continue
+                resolved = resolve_annotation_query(
+                    self.dicts, annotation, value
+                )
+                if resolved is None:
+                    results[qi] = []
+                    continue
+                ann_value, bann_key, bann_value, bann_value2 = resolved
+                if ann_value >= 0 and bann_key >= 0:
+                    fallback.append(qi)  # mixed: scan-only semantics
+                    continue
+                if ann_value >= 0:
+                    probes.append((qi, lay[dev.StoreConfig.CAND_ANN],
+                                   svc, ann_value, -1, False, False,
+                                   True, end_ts))
+                    continue
+                fam = lay[dev.StoreConfig.CAND_BANN]
+                if bann_value < 0 and bann_value2 < 0:
+                    probes.append((qi, fam, svc, bann_key, -1, True,
+                                   False, True, end_ts))
+                    continue
+                v1 = bann_value if bann_value >= 0 else bann_value2
+                v2 = bann_value2 if bann_value2 >= 0 else bann_value
+                probes.append((qi, fam, svc, bann_key, v1, True, False,
+                               True, end_ts))
+                if v2 != v1:
+                    probes.append((qi, fam, svc, bann_key, v2, True,
+                                   False, True, end_ts))
+        if probes:
+            k = max(1, max(limits[p[0]] for p in probes)) * 8
+            n = _next_pow2(len(probes))
+            cols = {key: [] for key in (
+                "b_base", "s_base", "n_b", "depth", "key1", "key2",
+                "key3", "three", "is_svc", "end_ts", "poison_on",
+            )}
+            for (_, fam, k1, k2, k3, three, is_svc, poison_on,
+                 end_ts) in probes:
+                b_base, s_base, n_b, depth = fam
+                cols["b_base"].append(b_base)
+                cols["s_base"].append(s_base)
+                cols["n_b"].append(n_b)
+                cols["depth"].append(depth)
+                cols["key1"].append(k1)
+                cols["key2"].append(k2)
+                cols["key3"].append(k3)
+                cols["three"].append(three)
+                cols["is_svc"].append(is_svc)
+                cols["end_ts"].append(end_ts)
+                cols["poison_on"].append(poison_on)
+            pad_fam = lay[dev.StoreConfig.CAND_SVC]
+            for _ in range(n - len(probes)):
+                cols["b_base"].append(pad_fam[0])
+                cols["s_base"].append(pad_fam[1])
+                cols["n_b"].append(pad_fam[2])
+                cols["depth"].append(pad_fam[3])
+                cols["key1"].append(0)
+                cols["key2"].append(-1)
+                cols["key3"].append(-1)
+                cols["three"].append(False)
+                cols["is_svc"].append(True)
+                cols["end_ts"].append(-1)
+                cols["poison_on"].append(False)
+            arrs = {key: np.asarray(v) for key, v in cols.items()}
+            with self._rw.read():
+                mats, completes, wms = jax.device_get(
+                    dev.iquery_trace_ids_multi(self.state, arrs, k)
+                )
+            k_eff = min(k, max(fam[3] for fam in lay))
+            by_q: Dict[int, list] = {}
+            for pi, p in enumerate(probes):
+                by_q.setdefault(p[0], []).append(pi)
+            for qi, pis in by_q.items():
+                cands = []
+                complete = True
+                wm = -(1 << 62)
+                saturated = False
+                win_total = 0
+                for pi in pis:
+                    mat = mats[pi]
+                    probe_cands = [
+                        (int(t), int(ts))
+                        for t, ts, v in zip(mat[0], mat[1], mat[2]) if v
+                    ]
+                    # A probe's EFFECTIVE window is bounded by its
+                    # family depth, not the kernel's padded k; a full
+                    # window may have truncated entries, and the
+                    # underfull-equals-complete claim must never fire
+                    # for the pair just because the other probe had
+                    # slack.
+                    window_pi = min(k_eff, probes[pi][1][3])
+                    win_total += window_pi
+                    saturated |= len(probe_cands) >= window_pi
+                    cands.extend(probe_cands)
+                    complete = complete and bool(completes[pi])
+                    wm = max(wm, int(wms[pi]))
+                if len(pis) > 1 and saturated:
+                    # Per-probe windows truncated independently: a
+                    # trace cut from one probe's top-k can outrank the
+                    # other probe's survivors, so no union-level claim
+                    # is sound — unlike the singular verify2 kernel,
+                    # which top-k's over the CONCATENATED buckets.
+                    ids = None
+                else:
+                    ids = index_topk_or_none(
+                        limits[qi], win_total, cands, complete, wm
+                    )
+                if ids is None:
+                    fallback.append(qi)
+                else:
+                    self.index_hits += 1
+                    results[qi] = ids
+        for qi in fallback:
+            q = queries[qi]
+            if q[0] == "name":
+                results[qi] = self.get_trace_ids_by_name(*q[1:])
+            else:
+                results[qi] = self.get_trace_ids_by_annotation(*q[1:])
+        return [r if r is not None else [] for r in results]
 
     # -- trace reads ----------------------------------------------------
 
@@ -577,11 +848,12 @@ class TpuSpanStore(SpanStore):
             np.asarray([to_signed64(t) for t in trace_ids], np.int64)
         )
 
-    def _durations_mat(self, qids: np.ndarray) -> np.ndarray:
+    def _durations_mat(self, qids: np.ndarray,
+                       force_scan: bool = False) -> np.ndarray:
         """[4, nq] duration matrix: trace-membership fast path when its
         exactness gate holds, the full-ring scan otherwise."""
         with self._rw.read():
-            if self.config.use_index:
+            if self.config.use_index and not force_scan:
                 mat, exact = jax.device_get(
                     dev.iquery_durations(self.state, qids)
                 )
@@ -598,14 +870,16 @@ class TpuSpanStore(SpanStore):
         return exist_from_duration_mat(canon, qids, mat[0], self.pins,
                                        self._lock)
 
-    def get_spans_by_trace_ids(self, trace_ids: Sequence[int]) -> List[List[Span]]:
+    def get_spans_by_trace_ids(self, trace_ids: Sequence[int],
+                               force_scan: bool = False
+                               ) -> List[List[Span]]:
         if not trace_ids:
             return []
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
             st = self.state
             payload = None
-            if self.config.use_index:
+            if self.config.use_index and not force_scan:
                 payload = self._gather_via_index(st, qids)
             if payload is None:
                 def fetch(k_s, k_a, k_b):
@@ -660,13 +934,13 @@ class TpuSpanStore(SpanStore):
         return index_gather_with_escalation(self.config, len(qids), fetch)
 
     def get_traces_duration(
-        self, trace_ids: Sequence[int]
+        self, trace_ids: Sequence[int], force_scan: bool = False
     ) -> List[TraceIdDuration]:
         if not trace_ids:
             return []
         canon = self._canon_ids(trace_ids)
         qids = self._sorted_qids(trace_ids)
-        mat = self._durations_mat(qids)
+        mat = self._durations_mat(qids, force_scan)
         return durations_from_mat(trace_ids, canon, qids, mat, self.pins,
                                   self._lock)
 
@@ -792,6 +1066,8 @@ class TpuSpanStore(SpanStore):
         # /metrics reads counters() generically).
         out["anns_truncated"] = float(self.anns_truncated)
         out["banns_truncated"] = float(self.banns_truncated)
+        out["index_hits"] = float(self.index_hits)
+        out["index_scan_fallbacks"] = float(self.index_fallbacks)
         return out
 
     def stored_span_count(self) -> float:

@@ -63,6 +63,11 @@ def generate_traces(
                 Annotation(start, "cs", client),
                 Annotation(start + 1, "sr", server),
                 Annotation(start + budget // 2, _name(rng), server),
+                # The fixed value the module docstring promises (and the
+                # annotation-query tests/benchmarks probe for) — same
+                # vocabulary as ColumnarTraceGen.
+                Annotation(start + budget // 2 + 1,
+                           "some custom annotation", server),
                 Annotation(end - 1, "ss", server),
                 Annotation(end, "cr", client),
             )
@@ -70,6 +75,10 @@ def generate_traces(
                 BinaryAnnotation(
                     _name(rng, 1), _name(rng, 3).encode(),
                     AnnotationType.BYTES, server,
+                ),
+                BinaryAnnotation(
+                    "http.uri", b"/api/widgets", AnnotationType.BYTES,
+                    server,
                 ),
             )
             spans.append(
