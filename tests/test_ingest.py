@@ -308,3 +308,32 @@ class TestKafkaSink:
         for s in spans:
             sink.apply([s])
         assert sink.stats == {"published": 2, "errors": 1}
+
+
+def test_kafka_record_value_stream_adapts_both_shapes():
+    """The documented client adapter: kafka-python style records (carry
+    .value bytes) and raw byte iterables both drain identically."""
+    from types import SimpleNamespace
+
+    from zipkin_tpu.ingest.kafka import record_value_stream
+
+    raw = [b"a", b"b"]
+    recs = [SimpleNamespace(value=b"a"), SimpleNamespace(value=b"b")]
+    assert list(record_value_stream(raw)) == raw
+    assert list(record_value_stream(recs)) == raw
+
+
+def test_connect_kafka_python_degrades_clearly_without_client():
+    """No kafka lib ships here: the real-client constructor must fail
+    with the contract message, not an obscure ImportError downstream."""
+    import pytest
+
+    from zipkin_tpu.ingest.kafka import connect_kafka_python
+
+    try:
+        import kafka  # noqa: F401
+        pytest.skip("kafka-python unexpectedly present")
+    except ImportError:
+        pass
+    with pytest.raises(RuntimeError, match="integration contract"):
+        connect_kafka_python(lambda spans: None, "localhost:9092")

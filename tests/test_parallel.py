@@ -154,3 +154,42 @@ def test_sharded_dep_links_survive_eviction(mesh):
         last_total = total
     expected = n * rounds * 4 * (gen.spans_per_trace - 1)
     assert last_total == expected
+
+
+def test_sharded_multi_query_matches_singular(mesh):
+    """ShardedSpanStore.get_trace_ids_multi (one mesh launch for all
+    probes) must answer exactly what the singular sharded paths — and a
+    same-geometry single-device oracle — answer."""
+    store = ShardedSpanStore(mesh, CFG)
+    oracle = TpuSpanStore(CFG)
+    spans = [s for t in generate_traces(n_traces=24, max_depth=3,
+                                        n_services=5) for s in t]
+    store.apply(spans)
+    oracle.apply(spans)
+    end_ts = max(s.last_timestamp for s in spans if s.last_timestamp) + 1
+    queries = []
+    for svc in sorted(oracle.get_all_service_names()):
+        queries.append(("name", svc, None, end_ts, 10))
+        queries.append(("annotation", svc, "some custom annotation",
+                        None, end_ts, 10))
+        queries.append(("annotation", svc, "http.uri", b"/api/widgets",
+                        end_ts, 10))
+        queries.append(("annotation", svc, "http.uri", None, end_ts, 10))
+    queries.append(("name", "no-such-svc", None, end_ts, 10))
+    got = store.get_trace_ids_multi(queries)
+    assert len(got) == len(queries)
+
+    def ids(r):
+        return sorted((i.trace_id, i.timestamp) for i in r)
+
+    nonempty = 0
+    for q, res in zip(queries, got):
+        if q[0] == "name":
+            single = store.get_trace_ids_by_name(*q[1:])
+            want = oracle.get_trace_ids_by_name(*q[1:])
+        else:
+            single = store.get_trace_ids_by_annotation(*q[1:])
+            want = oracle.get_trace_ids_by_annotation(*q[1:])
+        assert ids(res) == ids(single) == ids(want), q
+        nonempty += bool(want)
+    assert nonempty > 0

@@ -759,13 +759,98 @@ class ShardedSpanStore:
             limit, c.ann_capacity + c.bann_capacity, fetch
         )
 
-    def get_trace_ids_multi(self, queries):
-        """Generic per-query loop (ReadSpanStore contract). The sharded
-        store still pays one launch per slice; folding multi-probe into
-        the per-shard kernels is future work."""
-        from zipkin_tpu.store.base import ReadSpanStore
+    def _iq_multi(self, n: int, k: int):
+        """Batched multi-probe index kernel over the mesh: every probe
+        reads its bucket on EVERY shard in one launch (dev._iq_multi_impl
+        under shard_map); the host merges per-shard candidates."""
+        c = self.config
 
-        return ReadSpanStore.get_trace_ids_multi(self, queries)
+        def build():
+            k_max = max(fam[3] for fam in c.cand_layout[0])
+
+            def fn(state, b_base, s_base, n_b, depth, key1, key2, key3,
+                   three, is_svc, end_ts, poison_on):
+                st = self._unstack(state)
+                mat, complete, wm = dev._iq_multi_impl(
+                    st.cand_idx, st.cand_pos, st.cand_wm, st.row_gid,
+                    st.indexable, st.trace_id, st.ts_last,
+                    c.capacity, k, k_max,
+                    b_base, s_base, n_b, depth, key1, key2, key3,
+                    three, is_svc, end_ts, poison_on,
+                    st.ann_poison, st.write_pos, st.key_tab, st.key_wm,
+                )
+                return mat[None], complete[None], wm[None]
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(self.axis),) + (P(),) * 11,
+                out_specs=(P(self.axis),) * 3, check_vma=False,
+            ))
+
+        return self._kernel(("imulti", n, k), build)
+
+    def get_trace_ids_multi(self, queries):
+        """Batched index read over the mesh: all queries' probes ride
+        one launch; distrusted buckets fall back to the singular sharded
+        paths. Same trust policy as TpuSpanStore.get_trace_ids_multi
+        (shared resolve/gate helpers), with per-shard saturation folded
+        into each probe's flag."""
+        from zipkin_tpu.store.base import ReadSpanStore
+        from zipkin_tpu.store.tpu import (
+            build_probe_arrays,
+            gate_multi_probes,
+            resolve_multi_probes,
+        )
+
+        c = self.config
+        if not c.use_index or not queries:
+            return ReadSpanStore.get_trace_ids_multi(self, queries)
+        results, probes, limits, fallback = resolve_multi_probes(
+            c, self.dicts, queries
+        )
+        if probes:
+            # Unlike the single-device path, the mesh kernel takes the
+            # clamped k directly (k_eff); the raw request k is unused.
+            arrs, _, k_eff = build_probe_arrays(c, probes, limits)
+            order = ("b_base", "s_base", "n_b", "depth", "key1", "key2",
+                     "key3", "three", "is_svc", "end_ts", "poison_on")
+            with self._rw.read():
+                mats, completes, wms = jax.device_get(
+                    self._iq_multi(len(arrs["key1"]), k_eff)(
+                        self.states,
+                        *(jnp.asarray(arrs[name]) for name in order),
+                    )
+                )
+            per_probe = []
+            for pi, p in enumerate(probes):
+                window_pi = min(k_eff, p[1][3])
+                cands = []
+                saturated = False
+                for sh in range(mats.shape[0]):
+                    mat = mats[sh, pi]
+                    shard_cands = [
+                        (int(t), int(ts))
+                        for t, ts, v in zip(mat[0], mat[1], mat[2]) if v
+                    ]
+                    saturated |= len(shard_cands) >= window_pi
+                    cands.extend(shard_cands)
+                per_probe.append((
+                    cands, bool(np.all(completes[:, pi])),
+                    int(np.max(wms[:, pi])), saturated,
+                ))
+            gated = gate_multi_probes(probes, limits, per_probe)
+            for qi, ids in gated.items():
+                if ids is None:
+                    fallback.append(qi)
+                else:
+                    results[qi] = ids
+        for qi in fallback:
+            q = queries[qi]
+            if q[0] == "name":
+                results[qi] = self.get_trace_ids_by_name(*q[1:])
+            else:
+                results[qi] = self.get_trace_ids_by_annotation(*q[1:])
+        return [r if r is not None else [] for r in results]
 
     # -- trace reads -----------------------------------------------------
 

@@ -56,6 +56,151 @@ from zipkin_tpu.store.base import (
 _BATCH_MIN = 64
 
 
+def resolve_multi_probes(config, dicts, queries):
+    """Turn a ``get_trace_ids_multi`` query list into index-bucket probe
+    rows (shared by the single-device and sharded stores).
+
+    Returns (results, probes, limits, fallback):
+    - ``results``: per-query list, pre-filled with [] for queries that
+      resolve to nothing (unknown service/name/value), None otherwise;
+    - ``probes``: (query_idx, fam_row, key1, key2, key3, three, is_svc,
+      poison_on, end_ts) tuples — fam_row is a config.cand_layout row;
+    - ``limits``: per-query limit;
+    - ``fallback``: query indices that must use the singular path
+      (mixed user-annotation + binary-key names: OR-across-families is
+      scan-only semantics).
+    """
+    lay, _, _ = config.cand_layout
+    results = [None] * len(queries)
+    fallback: List[int] = []
+    probes: List[tuple] = []
+    limits = [0] * len(queries)
+    for qi, q in enumerate(queries):
+        if q[0] == "name":
+            _, service, span_name, end_ts, limit = q
+            limits[qi] = limit
+            svc = dicts.services.get(service.lower())
+            if svc is None or limit <= 0:
+                results[qi] = []
+                continue
+            if span_name is not None:
+                name_lc = dicts.span_names.get(span_name.lower())
+                if name_lc is None:
+                    results[qi] = []
+                    continue
+                probes.append((qi, lay[dev.StoreConfig.CAND_NAME],
+                               svc, name_lc, -1, False, False, False,
+                               end_ts))
+            else:
+                probes.append((qi, lay[dev.StoreConfig.CAND_SVC],
+                               svc, -1, -1, False, True, False, end_ts))
+        else:
+            _, service, annotation, value, end_ts, limit = q
+            limits[qi] = limit
+            if annotation in CORE_ANNOTATIONS or limit <= 0:
+                results[qi] = []
+                continue
+            svc = dicts.services.get(service.lower())
+            if svc is None:
+                results[qi] = []
+                continue
+            resolved = resolve_annotation_query(dicts, annotation, value)
+            if resolved is None:
+                results[qi] = []
+                continue
+            ann_value, bann_key, bann_value, bann_value2 = resolved
+            if ann_value >= 0 and bann_key >= 0:
+                fallback.append(qi)  # mixed: scan-only semantics
+                continue
+            if ann_value >= 0:
+                probes.append((qi, lay[dev.StoreConfig.CAND_ANN],
+                               svc, ann_value, -1, False, False, True,
+                               end_ts))
+                continue
+            fam = lay[dev.StoreConfig.CAND_BANN]
+            if bann_value < 0 and bann_value2 < 0:
+                probes.append((qi, fam, svc, bann_key, -1, True, False,
+                               True, end_ts))
+                continue
+            v1 = bann_value if bann_value >= 0 else bann_value2
+            v2 = bann_value2 if bann_value2 >= 0 else bann_value
+            probes.append((qi, fam, svc, bann_key, v1, True, False,
+                           True, end_ts))
+            if v2 != v1:
+                probes.append((qi, fam, svc, bann_key, v2, True, False,
+                               True, end_ts))
+    return results, probes, limits, fallback
+
+
+def build_probe_arrays(config, probes, limits):
+    """Pack probe rows into the dtype-final numpy arrays
+    dev._iq_multi_impl consumes, padded to a power-of-two probe count
+    (bounds the compile cache). Padding probes are harmless service
+    probes with end_ts=-1 (match nothing). Returns (arrays, k, k_eff):
+    ``k`` the requested per-probe candidate count, ``k_eff`` the
+    kernel's actual clamp (widest family depth)."""
+    lay, _, _ = config.cand_layout
+    k = max(1, max(limits[p[0]] for p in probes)) * 8
+    n = _next_pow2(len(probes))
+    pad_fam = lay[dev.StoreConfig.CAND_SVC]
+    pad_row = (None, pad_fam, 0, -1, -1, False, True, False, -1)
+    rows = probes + [pad_row] * (n - len(probes))
+    arrs = {
+        "b_base": np.asarray([r[1][0] for r in rows], np.int64),
+        "s_base": np.asarray([r[1][1] for r in rows], np.int64),
+        "n_b": np.asarray([r[1][2] for r in rows], np.int64),
+        "depth": np.asarray([r[1][3] for r in rows], np.int64),
+        "key1": np.asarray([r[2] for r in rows], np.int32),
+        "key2": np.asarray([r[3] for r in rows], np.int32),
+        "key3": np.asarray([r[4] for r in rows], np.int32),
+        "three": np.asarray([r[5] for r in rows], bool),
+        "is_svc": np.asarray([r[6] for r in rows], bool),
+        "poison_on": np.asarray([r[7] for r in rows], bool),
+        "end_ts": np.asarray([r[8] for r in rows], np.int64),
+    }
+    k_eff = min(k, max(fam[3] for fam in lay))
+    return arrs, k, k_eff
+
+
+def gate_multi_probes(probes, limits, per_probe):
+    """Shared trust gating for batched index probes. ``per_probe`` is
+    aligned with ``probes``: (candidates, complete, watermark,
+    saturated) — saturated meaning the probe's effective window filled
+    (its candidates may be truncated). Returns {query_idx: ids-or-None}
+    where None = the query must fall back to its singular path."""
+    by_q: Dict[int, list] = {}
+    for pi, p in enumerate(probes):
+        by_q.setdefault(p[0], []).append(pi)
+    out = {}
+    for qi, pis in by_q.items():
+        cands = []
+        complete = True
+        wm = -(1 << 62)
+        saturated = False
+        win_total = 0
+        for pi in pis:
+            c_, comp_, wm_, sat_ = per_probe[pi]
+            cands.extend(c_)
+            complete = complete and comp_
+            wm = max(wm, wm_)
+            saturated |= sat_
+            # window > len ⇔ unsaturated: the underfull-equals-complete
+            # claim may only fire when NO probe truncated its window.
+            win_total += len(c_) + (0 if sat_ else 1)
+        if len(pis) > 1 and saturated:
+            # Per-probe windows truncated independently: a trace cut
+            # from one probe's top-k can outrank the other probe's
+            # survivors, so no union-level claim is sound — unlike the
+            # singular verify2 kernel, which top-k's over the
+            # CONCATENATED buckets.
+            out[qi] = None
+        else:
+            out[qi] = index_topk_or_none(
+                limits[qi], win_total, cands, complete, wm
+            )
+    return out
+
+
 def _next_pow2(n: int) -> int:
     p = _BATCH_MIN
     while p < n:
@@ -677,147 +822,29 @@ class TpuSpanStore(SpanStore):
         c = self.config
         if not c.use_index or not queries:
             return super().get_trace_ids_multi(queries)
-        lay, _, _ = c.cand_layout
-        results: List[Optional[List[IndexedTraceId]]] = [None] * len(queries)
-        fallback: List[int] = []
-        probes: List[tuple] = []  # (qi, fam_row, key1, key2, key3,
-        #                            three, is_svc, poison_on, end_ts)
-        limits = [0] * len(queries)
-        for qi, q in enumerate(queries):
-            if q[0] == "name":
-                _, service, span_name, end_ts, limit = q
-                limits[qi] = limit
-                svc = self._svc_id(service)
-                if svc is None or limit <= 0:
-                    results[qi] = []
-                    continue
-                if span_name is not None:
-                    name_lc = self.dicts.span_names.get(span_name.lower())
-                    if name_lc is None:
-                        results[qi] = []
-                        continue
-                    probes.append((qi, lay[dev.StoreConfig.CAND_NAME],
-                                   svc, name_lc, -1, False, False, False,
-                                   end_ts))
-                else:
-                    probes.append((qi, lay[dev.StoreConfig.CAND_SVC],
-                                   svc, -1, -1, False, True, False,
-                                   end_ts))
-            else:
-                _, service, annotation, value, end_ts, limit = q
-                limits[qi] = limit
-                if annotation in CORE_ANNOTATIONS or limit <= 0:
-                    results[qi] = []
-                    continue
-                svc = self._svc_id(service)
-                if svc is None:
-                    results[qi] = []
-                    continue
-                resolved = resolve_annotation_query(
-                    self.dicts, annotation, value
-                )
-                if resolved is None:
-                    results[qi] = []
-                    continue
-                ann_value, bann_key, bann_value, bann_value2 = resolved
-                if ann_value >= 0 and bann_key >= 0:
-                    fallback.append(qi)  # mixed: scan-only semantics
-                    continue
-                if ann_value >= 0:
-                    probes.append((qi, lay[dev.StoreConfig.CAND_ANN],
-                                   svc, ann_value, -1, False, False,
-                                   True, end_ts))
-                    continue
-                fam = lay[dev.StoreConfig.CAND_BANN]
-                if bann_value < 0 and bann_value2 < 0:
-                    probes.append((qi, fam, svc, bann_key, -1, True,
-                                   False, True, end_ts))
-                    continue
-                v1 = bann_value if bann_value >= 0 else bann_value2
-                v2 = bann_value2 if bann_value2 >= 0 else bann_value
-                probes.append((qi, fam, svc, bann_key, v1, True, False,
-                               True, end_ts))
-                if v2 != v1:
-                    probes.append((qi, fam, svc, bann_key, v2, True,
-                                   False, True, end_ts))
+        results, probes, limits, fallback = resolve_multi_probes(
+            c, self.dicts, queries
+        )
         if probes:
-            k = max(1, max(limits[p[0]] for p in probes)) * 8
-            n = _next_pow2(len(probes))
-            cols = {key: [] for key in (
-                "b_base", "s_base", "n_b", "depth", "key1", "key2",
-                "key3", "three", "is_svc", "end_ts", "poison_on",
-            )}
-            for (_, fam, k1, k2, k3, three, is_svc, poison_on,
-                 end_ts) in probes:
-                b_base, s_base, n_b, depth = fam
-                cols["b_base"].append(b_base)
-                cols["s_base"].append(s_base)
-                cols["n_b"].append(n_b)
-                cols["depth"].append(depth)
-                cols["key1"].append(k1)
-                cols["key2"].append(k2)
-                cols["key3"].append(k3)
-                cols["three"].append(three)
-                cols["is_svc"].append(is_svc)
-                cols["end_ts"].append(end_ts)
-                cols["poison_on"].append(poison_on)
-            pad_fam = lay[dev.StoreConfig.CAND_SVC]
-            for _ in range(n - len(probes)):
-                cols["b_base"].append(pad_fam[0])
-                cols["s_base"].append(pad_fam[1])
-                cols["n_b"].append(pad_fam[2])
-                cols["depth"].append(pad_fam[3])
-                cols["key1"].append(0)
-                cols["key2"].append(-1)
-                cols["key3"].append(-1)
-                cols["three"].append(False)
-                cols["is_svc"].append(True)
-                cols["end_ts"].append(-1)
-                cols["poison_on"].append(False)
-            arrs = {key: np.asarray(v) for key, v in cols.items()}
+            arrs, k, k_eff = build_probe_arrays(c, probes, limits)
             with self._rw.read():
                 mats, completes, wms = jax.device_get(
                     dev.iquery_trace_ids_multi(self.state, arrs, k)
                 )
-            k_eff = min(k, max(fam[3] for fam in lay))
-            by_q: Dict[int, list] = {}
+            per_probe = []
             for pi, p in enumerate(probes):
-                by_q.setdefault(p[0], []).append(pi)
-            for qi, pis in by_q.items():
-                cands = []
-                complete = True
-                wm = -(1 << 62)
-                saturated = False
-                win_total = 0
-                for pi in pis:
-                    mat = mats[pi]
-                    probe_cands = [
-                        (int(t), int(ts))
-                        for t, ts, v in zip(mat[0], mat[1], mat[2]) if v
-                    ]
-                    # A probe's EFFECTIVE window is bounded by its
-                    # family depth, not the kernel's padded k; a full
-                    # window may have truncated entries, and the
-                    # underfull-equals-complete claim must never fire
-                    # for the pair just because the other probe had
-                    # slack.
-                    window_pi = min(k_eff, probes[pi][1][3])
-                    win_total += window_pi
-                    saturated |= len(probe_cands) >= window_pi
-                    cands.extend(probe_cands)
-                    complete = complete and bool(completes[pi])
-                    wm = max(wm, int(wms[pi]))
-                if len(pis) > 1 and saturated:
-                    # Per-probe windows truncated independently: a
-                    # trace cut from one probe's top-k can outrank the
-                    # other probe's survivors, so no union-level claim
-                    # is sound — unlike the singular verify2 kernel,
-                    # which top-k's over the CONCATENATED buckets.
-                    ids = None
-                else:
-                    ids = index_topk_or_none(
-                        limits[qi], win_total, cands, complete, wm
-                    )
+                mat = mats[pi]
+                cands = [
+                    (int(t), int(ts))
+                    for t, ts, v in zip(mat[0], mat[1], mat[2]) if v
+                ]
+                window_pi = min(k_eff, p[1][3])
+                per_probe.append((
+                    cands, bool(completes[pi]), int(wms[pi]),
+                    len(cands) >= window_pi,
+                ))
+            gated = gate_multi_probes(probes, limits, per_probe)
+            for qi, ids in gated.items():
                 if ids is None:
                     fallback.append(qi)
                 else:
