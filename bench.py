@@ -194,11 +194,20 @@ def _make_template(store, n_services: int, batch_traces: int):
         )
 
     @partial(jax.jit, donate_argnums=(0, 2), static_argnums=(3,))
-    def fused_chain(state, db, step, k):
+    def fused_chain(state, db, step, k, do_close):
         """k restamp+ingest steps per LAUNCH via lax.scan: one ~100ms
         dispatch amortizes over k batches (~5-7ms per scan iteration,
         NOTES_r03 §3) instead of being paid per batch — the dispatch-
-        floor attack VERDICT r3 item 3 asked for."""
+        floor attack VERDICT r3 item 3 asked for. ``do_close`` folds the
+        dependency-bucket close (the archive-cadence launch) into the
+        same dispatch: lax.cond executes one branch at runtime, so a
+        False close is near-free and a True one saves a whole call
+        floor."""
+        state = jax.lax.cond(
+            do_close, dev.dep_close_bucket.__wrapped__, lambda s: s,
+            state,
+        )
+
         def body(carry, _):
             st, stp = carry
             st = dev.ingest_step.__wrapped__(st, restamp(db, stp))
@@ -210,6 +219,36 @@ def _make_template(store, n_services: int, batch_traces: int):
         return state, step
 
     return db0, fused_chain, pad_spans
+
+
+def _hlo_stats(jitfn, *args):
+    """Instruction/fusion/sort counts of the compiled module's entry
+    computation — the op-count evidence NOTES_r03 §4 tracked by hand.
+    Uses the AOT lowering path, which shares the jit compile cache, so
+    this costs one (cached) compile, not two."""
+    try:
+        txt = jitfn.lower(*args).compile().as_text()
+        entry, depth, counts = False, 0, {"instr": 0, "fusion": 0,
+                                          "sort": 0}
+        for line in txt.splitlines():
+            s = line.strip()
+            if s.startswith("ENTRY "):
+                entry, depth = True, 0
+            if not entry:
+                continue
+            depth += s.count("{") - s.count("}")
+            if " = " in s:
+                counts["instr"] += 1
+                if " fusion(" in s:
+                    counts["fusion"] += 1
+                if " sort(" in s:
+                    counts["sort"] += 1
+            if depth <= 0 and "}" in s and counts["instr"]:
+                break
+        return (f"{counts['instr']} entry instrs, "
+                f"{counts['fusion']} fusions, {counts['sort']} sorts")
+    except Exception as e:  # noqa: BLE001 — diagnostics only
+        return f"hlo stats unavailable: {e!r}"
 
 
 def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
@@ -225,10 +264,19 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
 
     config = _tpu_config(capacity_log2, n_services, use_pallas)
     store = TpuSpanStore(config)
+    cap = config.capacity
+    # One launch must never outrun the archive cadence (one dependency-
+    # bucket close per half ring) nor wrap the ring within itself: the
+    # whole stream loop is built on spans_per_call <= cap/2. Clamp
+    # oversized --batch-traces instead of silently corrupting state.
+    max_traces = max(1, (cap // 2) // SPT)
+    if batch_traces > max_traces:
+        _log(f"stream: --batch-traces {batch_traces} exceeds half-ring "
+             f"budget; clamped to {max_traces}")
+        batch_traces = max_traces
     db0, fused_chain, pad_spans = _make_template(
         store, n_services, batch_traces
     )
-    cap = config.capacity
     # Chain length: as many batches per launch as fit HALF the ring
     # (the archive cadence closes a dependency bucket once per half
     # capacity, and a single launch must not outrun it), capped at 32.
@@ -242,16 +290,18 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
         # stream with dispatch time only.
         return float(jax.device_get(x))
 
-    # Warm the compile caches on a throwaway state (donated away).
+    # Warm the compile caches on a throwaway state (donated away) and
+    # record the compiled step's HLO shape (op-count discipline,
+    # NOTES_r03 §4: per-kernel overhead prices every extra instruction).
     _log(f"stream: compiling (capacity 2^{capacity_log2}, "
          f"{n_services} services, chain {chain}, pallas={use_pallas})")
     wstate = dev.init_state(config)
-    wstate, wstep = fused_chain(wstate, db0, jnp.int64(0), chain)
+    hlo = _hlo_stats(fused_chain, wstate, db0, jnp.int64(0), chain,
+                     jnp.bool_(False))
+    wstate, wstep = fused_chain(wstate, db0, jnp.int64(0), chain,
+                                jnp.bool_(True))
     sync(wstate.counters["spans_seen"])
-    _log("stream: ingest compiled")
-    wstate = dev.dep_archive_auto(wstate, pad_spans)
-    sync(wstate.counters["spans_seen"])
-    _log("stream: archive compiled")
+    _log(f"stream: ingest (+fused bucket close) compiled ({hlo})")
     del wstate, wstep
 
     state = store.state
@@ -263,14 +313,16 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
     for i in range(n_calls):
         # Production archive policy (TpuSpanStore._maybe_archive), at
         # launch granularity: one chained launch ingests spans_per_call
-        # spans (<= cap/2 by construction).
-        if wp + spans_per_call - archived > cap:
-            state = dev.dep_archive_auto(state, pad_spans)
+        # spans (<= cap/2 by construction). The bucket close rides the
+        # SAME launch via the fused do_close flag.
+        do_close = wp + spans_per_call - archived > cap
+        if do_close:
             archived = min(
                 wp, max(wp + spans_per_call - cap, wp - cap // 2)
             )
             archive_runs += 1
-        state, step = fused_chain(state, db0, step, chain)
+        state, step = fused_chain(state, db0, step, chain,
+                                  jnp.bool_(do_close))
         wp += spans_per_call
         if (i + 1) % 8 == 0:
             # True barrier every 8 launches: bounds the async queue
@@ -596,6 +648,10 @@ def main():
                     help="TPU stream length (default 1e8, smoke 2e5)")
     ap.add_argument("--preflight-timeout", type=float, default=90.0,
                     help="seconds to wait for accelerator backend init")
+    ap.add_argument("--batch-traces", type=int, default=16384,
+                    help="traces per template batch in the full config "
+                         "(x7 spans; larger batches shrink the per-scan-"
+                         "iteration floor share — tune on real hardware)")
     args = ap.parse_args()
 
     detail = {}
@@ -627,10 +683,12 @@ def main():
         if args.smoke:
             store, ingest = bench_tpu_stream(
                 int(args.spans or 2e5), capacity_log2=16, n_services=64,
-                batch_traces=1024,
+                batch_traces=min(args.batch_traces, 1024),
             )
         else:
-            store, ingest = bench_tpu_stream(int(args.spans or 1e8))
+            store, ingest = bench_tpu_stream(
+                int(args.spans or 1e8), batch_traces=args.batch_traces
+            )
         detail["config2_tpu_ingest"] = ingest
         detail["tpu_queries"] = bench_tpu_queries(
             store, reps=5 if args.smoke else 12
