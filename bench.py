@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -673,6 +674,23 @@ def main():
     else:
         _log(f"backend preflight: {info}")
 
+    # Persistent compilation cache: cold compiles at bench shapes cost
+    # ~5 min (NOTES_r03 §7); repeated runs (retries, the 1B follow-up
+    # stream, post-outage re-runs) should pay it once per machine.
+    try:
+        import getpass
+        import tempfile
+
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(tempfile.gettempdir(),
+                         f"jax_cache_{getpass.getuser()}"),
+        )
+    except Exception as e:  # noqa: BLE001 — best-effort optimization
+        _log(f"compilation cache unavailable: {e!r}")
+
     # The SQL CPU reference: it needs no device, so even a dead TPU
     # backend still yields a valid one-line JSON result instead of an
     # empty benchmark record.
@@ -702,6 +720,33 @@ def main():
             detail["compare_kernels"] = bench_compare_kernels(
                 total_spans=int(2e5) if args.smoke else int(1e7)
             )
+        # The BASELINE north star: 1B spans ingested and queried on one
+        # chip. Attempt it automatically whenever the measured 100M
+        # throughput makes 1e9 tractable (>= 0.7M spans/s ⇒ <= ~24 min
+        # of streaming) — so an unattended end-of-round run carries the
+        # evidence, not just a hand-driven session.
+        if (not args.smoke and args.spans is None
+                and ingest["spans_per_s"] >= 7e5):
+            if not args.compare_kernels:
+                del store
+            _log(f"1B attempt: {ingest['spans_per_s'] / 1e6:.2f}M "
+                 f"spans/s makes 1e9 tractable; streaming")
+            try:
+                # Extra-credit run: its failure must not mark the
+                # completed core benchmark as a TPU-path failure.
+                store1b, stats1b = bench_tpu_stream(
+                    int(1e9), batch_traces=args.batch_traces
+                )
+                detail["config2b_1B_ingest"] = stats1b
+                detail["tpu_queries_1B"] = bench_tpu_queries(
+                    store1b, reps=8
+                )
+                detail["exactness_1B"] = bench_exactness(store1b,
+                                                         n_queries=12)
+                del store1b
+            except Exception as e:  # noqa: BLE001
+                _log(f"1B attempt failed: {e!r}")
+                detail["tpu_1b_error"] = repr(e)
     except Exception as e:  # noqa: BLE001 — emit a record either way
         _log(f"TPU path failed: {e!r}")
         detail["tpu_error"] = repr(e)
