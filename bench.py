@@ -678,15 +678,14 @@ def main():
     # ~5 min (NOTES_r03 §7); repeated runs (retries, the 1B follow-up
     # stream, post-outage re-runs) should pay it once per machine.
     try:
-        import getpass
-        import tempfile
-
         import jax
 
+        # User-private location (NOT the world-writable temp dir, where
+        # a predictable path could be pre-created by another user).
         jax.config.update(
             "jax_compilation_cache_dir",
-            os.path.join(tempfile.gettempdir(),
-                         f"jax_cache_{getpass.getuser()}"),
+            os.path.join(os.path.expanduser("~"), ".cache",
+                         "zipkin_tpu_jax"),
         )
     except Exception as e:  # noqa: BLE001 — best-effort optimization
         _log(f"compilation cache unavailable: {e!r}")

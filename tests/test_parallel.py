@@ -193,3 +193,40 @@ def test_sharded_multi_query_matches_singular(mesh):
         assert ids(res) == ids(single) == ids(want), q
         nonempty += bool(want)
     assert nonempty > 0
+
+
+def test_multihost_routing_math(mesh):
+    """parallel/multihost: the producer-side partitioner, the store's
+    placement hash, and the per-process consume set must agree — the
+    invariant that makes every consumed span local-by-construction."""
+    from zipkin_tpu.parallel import multihost as mh
+
+    store = ShardedSpanStore(mesh, CFG)
+    n = store.n
+    spans = [s for t in generate_traces(n_traces=20, max_depth=3,
+                                        n_services=4) for s in t]
+    # Partitioner == store placement, span-for-span.
+    for s in spans:
+        assert mh.partition_for_trace(s.trace_id, n) == \
+            store._shard_of(s.trace_id)
+    # Single-host: this process owns EVERY shard of the global mesh.
+    gmesh = mh.global_mesh()
+    local = mh.local_shard_ids(gmesh)
+    assert local == list(range(len(jax.devices())))
+    assert mh.partitions_for_process(gmesh) == local
+    # Routing groups: complete partition, trace-affine, and filterable
+    # to an owned subset.
+    groups = mh.route_spans(spans, n)
+    assert sum(len(g) for g in groups.values()) == len(spans)
+    for sid, group in groups.items():
+        assert all(mh.shard_of(s.trace_id, n) == sid for s in group)
+    owned = [0, 1]
+    sub = mh.route_spans(spans, n, keep=owned)
+    assert set(sub) <= set(owned)
+    assert sum(len(g) for g in sub.values()) == \
+        sum(len(g) for sid, g in groups.items() if sid in owned)
+    # A locally-routed group ingests cleanly and reads back.
+    if 0 in groups and groups[0]:
+        store.apply(groups[0])
+        tid = groups[0][0].trace_id
+        assert store.get_spans_by_trace_ids([tid])

@@ -1,0 +1,116 @@
+"""Multi-host deployment of the sharded store (SURVEY §2.8).
+
+The reference scales out with stateless JVM collectors behind ZooKeeper
+server-sets and a storage tier any collector can write to
+(ScribeSpanReceiver.scala:42-56, CassieSpanStore key-range sharding).
+The TPU build's storage is DEVICE-resident, so scale-out becomes a
+placement problem: every trace lives on exactly one shard of a global
+``jax.sharding.Mesh``, and a span must reach the HOST that owns that
+shard before it can be written. This module provides the three pieces
+of that story; the collectives themselves (psum/pmax summaries inside
+``shard_map``) are the same code single-host uses — XLA routes them
+over ICI within a slice and DCN across hosts, nothing in
+``parallel/shard.py`` changes.
+
+1. ``initialize`` — ``jax.distributed.initialize`` wrapper: one process
+   per host, a coordinator address, and the global device view.
+2. ``global_mesh`` / ``local_shard_ids`` — the global 1-D shard mesh
+   and the slice of it this process physically owns (its addressable
+   devices).
+3. Trace routing: ``shard_of`` is the SAME trace-affine hash
+   ``ShardedSpanStore`` uses, so the data plane can route spans to
+   owner hosts *before* ingest. The intended transport is the Kafka
+   path that already exists: produce with ``partition_for_trace`` (a
+   topic with one partition per shard), and each host consumes exactly
+   ``partitions_for_process`` — Kafka becomes the cross-host routing
+   tier (the role ZooKeeper-discovered scribe fanout played for the
+   reference), and every consumed span is local-by-construction.
+
+No multi-host fabric exists in this environment, so ``initialize`` is
+exercised only for its argument handling; the routing math — the part
+correctness depends on — is pure and unit-tested
+(tests/test_parallel.py::test_multihost_routing_math).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+# Keep the hash in lockstep with ShardedSpanStore._shard_of: one
+# constant, two call sites, zero drift.
+_GOLDEN = 0x9E3779B97F4A7C15
+
+
+def shard_of(trace_id: int, n_shards: int) -> int:
+    """Owning shard of a trace — identical to ShardedSpanStore's
+    trace-affine routing (parallel/shard.py), applied to the GLOBAL
+    shard count."""
+    from zipkin_tpu.columnar.encode import to_signed64
+
+    return (to_signed64(trace_id) * _GOLDEN) % n_shards
+
+
+def partition_for_trace(trace_id: int, n_shards: int) -> int:
+    """Kafka partition key for a span: partition i feeds shard i. A
+    producer using this guarantees every message a host consumes is for
+    a shard that host owns."""
+    return shard_of(trace_id, n_shards)
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int) -> None:
+    """Join the multi-host jax runtime (one call per process, before
+    any jax computation). Thin wrapper so deployments depend on this
+    module, not on jax internals."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis: str = "shard"):
+    """The global 1-D shard mesh over every device of every process.
+    Single-host this is exactly the mesh the tests/dryrun build."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), axis_names=(axis,))
+
+
+def local_shard_ids(mesh) -> List[int]:
+    """Global shard indices whose device is addressable from THIS
+    process — the shards this host feeds and serves. The mesh is the
+    1-D shard mesh from ``global_mesh`` (flattened if not)."""
+    import jax
+
+    local = {d.id for d in jax.local_devices()}
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    return [i for i, d in enumerate(devs) if d.id in local]
+
+
+def partitions_for_process(mesh) -> List[int]:
+    """Kafka partitions this process must consume: exactly its local
+    shards' indices (partition i ↔ shard i)."""
+    return local_shard_ids(mesh)
+
+
+def route_spans(spans: Sequence, n_shards: int,
+                keep: Optional[Sequence[int]] = None):
+    """Group spans by owning shard; ``keep`` (e.g. this process's local
+    shard ids) filters to locally-owned groups. Returns
+    {shard_id: [spans]} — the host-side pre-partitioning a multi-host
+    feed applies before ShardedSpanStore.apply (which re-derives the
+    same affinity, so a locally-complete group lands intact)."""
+    keep_set = None if keep is None else set(keep)
+    out = {}
+    for s in spans:
+        sid = shard_of(s.trace_id, n_shards)
+        if keep_set is not None and sid not in keep_set:
+            continue
+        out.setdefault(sid, []).append(s)
+    return out

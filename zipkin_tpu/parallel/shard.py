@@ -253,9 +253,12 @@ class ShardedSpanStore:
     # -- writes ---------------------------------------------------------
 
     def _shard_of(self, trace_id: int) -> int:
-        from zipkin_tpu.columnar.encode import to_signed64
+        # Shared with the multi-host routing tier (parallel/multihost
+        # partition_for_trace): one hash, no drift between the producer
+        # partitioner and the store's placement.
+        from zipkin_tpu.parallel.multihost import shard_of
 
-        return (to_signed64(trace_id) * 0x9E3779B97F4A7C15) % self.n
+        return shard_of(trace_id, self.n)
 
     def apply(self, spans) -> None:
         from zipkin_tpu.columnar.encode import to_signed64
