@@ -38,6 +38,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from zipkin_tpu.columnar.encode import to_signed64
+
 # Keep the hash in lockstep with ShardedSpanStore._shard_of: one
 # constant, two call sites, zero drift.
 _GOLDEN = 0x9E3779B97F4A7C15
@@ -46,9 +48,8 @@ _GOLDEN = 0x9E3779B97F4A7C15
 def shard_of(trace_id: int, n_shards: int) -> int:
     """Owning shard of a trace — identical to ShardedSpanStore's
     trace-affine routing (parallel/shard.py), applied to the GLOBAL
-    shard count."""
-    from zipkin_tpu.columnar.encode import to_signed64
-
+    shard count. Called once per span on the ingest routing path, so
+    to_signed64 is bound at module scope, not per call."""
     return (to_signed64(trace_id) * _GOLDEN) % n_shards
 
 
