@@ -125,6 +125,18 @@ def bench_sql_baseline(total_spans: int = 10_000):
 def _tpu_config(capacity_log2: int, n_services: int, use_pallas: bool):
     from zipkin_tpu.store import device as dev
 
+    # Index sizing for the benchmark's UNIFORM key space (1k services x
+    # 2k span names => ~2M live (host, name) pairs; the default derived
+    # geometry caps far below that):
+    # - (service, span-name) family slots ~2x the annotation ring, so in
+    #   steady state everything a bucket displaced is already evicted
+    #   and the per-key displaced-gid gate holds (the tr_wm sizing rule,
+    #   store/device.py) — by-name queries answer from the index instead
+    #   of the O(ring) scan;
+    # - per-key cursor table ~2x the live key count, so claims don't
+    #   saturate and sparse pairs keep their records.
+    # Cost at capacity 2^22: ~+330MB name family, ~+66MB key table.
+    big = capacity_log2 >= 20
     return dev.StoreConfig(
         capacity=1 << capacity_log2,
         ann_capacity=1 << (capacity_log2 + 1),
@@ -137,6 +149,9 @@ def _tpu_config(capacity_log2: int, n_services: int, use_pallas: bool):
         hll_p=14,
         quantile_buckets=2048,
         use_pallas=use_pallas,
+        idx_name_buckets=(1 << 16) if big else 0,
+        idx_name_depth=256 if big else 0,
+        idx_key_slots=(1 << 22) if big else 0,
     )
 
 

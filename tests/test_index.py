@@ -559,3 +559,80 @@ def test_sparse_key_under_hot_bucket_stays_on_fast_path():
         [("annotation", "websvc", "rare marker", None, end_ts, 10)])
     assert _ids(multi[0]) == _ids(want)
     assert fast.index_fallbacks == 0
+
+
+def test_negative_lookup_stays_on_fast_path():
+    """A query for a key that was NEVER indexed must answer [] from the
+    index even when its hashed bucket wrapped on other keys' traffic:
+    zero claim drops + absent key record prove emptiness (the
+    reference's instant empty-row read)."""
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+
+    cfg = _cfg(True, idx_ann_buckets=1, idx_ann_depth=64)
+    fast, scan = TpuSpanStore(cfg), TpuSpanStore(_cfg(False))
+    ep = Endpoint(1, 80, "websvc")
+    other = Endpoint(2, 80, "othersvc")
+    spans = [
+        Span(20_000 + i, "op", 1, None,
+             (Annotation(1000 + 10 * i, "sr", ep),
+              Annotation(1001 + 10 * i, "hot marker", ep)), ())
+        for i in range(150)  # wraps the single 64-deep ann bucket 2x+
+    ]
+    # Interns "ghost marker", but ONLY under othersvc.
+    spans.append(Span(30_000, "op", 1, None,
+                      (Annotation(5000, "sr", other),
+                       Annotation(5001, "ghost marker", other)), ()))
+    for st in (fast, scan):
+        st.apply(spans)
+    end_ts = 10_000
+    assert fast.index_fallbacks == 0
+    got = fast.get_trace_ids_by_annotation(
+        "websvc", "ghost marker", None, end_ts, 10)
+    want = scan.get_trace_ids_by_annotation(
+        "websvc", "ghost marker", None, end_ts, 10)
+    assert got == want == []
+    # Answered by the negative gate, not the O(ring) scan.
+    assert fast.index_fallbacks == 0 and fast.index_hits == 1
+    # Same through the batched path.
+    multi = fast.get_trace_ids_multi(
+        [("annotation", "websvc", "ghost marker", None, end_ts, 10)])
+    assert multi[0] == []
+    assert fast.index_fallbacks == 0
+
+
+def test_pre_rev8_snapshot_disables_negative_gate(tmp_path):
+    """A revision-7 snapshot kept its key table but never counted claim
+    drops, so an absent record proves nothing: restores must force the
+    drop counter >= 1 (negative gate off) for the store's lifetime."""
+    import json
+    import os
+
+    import numpy as np
+
+    from zipkin_tpu import checkpoint
+
+    store = TpuSpanStore(_cfg(True))
+    store.apply(SPANS)
+    path = str(tmp_path / "rev7")
+    checkpoint.save(store, path)
+    state_file = os.path.join(path, "state.npz")
+    data = dict(np.load(state_file))
+    del data["counters.key_claim_drops"]
+    np.savez_compressed(state_file, **data)
+    meta_file = os.path.join(path, "meta.json")
+    with open(meta_file) as f:
+        meta = json.load(f)
+    meta["revision"] = 7
+    with open(meta_file, "w") as f:
+        json.dump(meta, f)
+    restored = checkpoint.load(path)
+    assert int(np.asarray(
+        restored.state.counters["key_claim_drops"]
+    )) >= 1
+    # Current-revision snapshots round-trip the counter untouched.
+    path2 = str(tmp_path / "rev8")
+    checkpoint.save(store, path2)
+    again = checkpoint.load(path2)
+    assert int(np.asarray(
+        again.state.counters["key_claim_drops"]
+    )) == int(np.asarray(store.state.counters["key_claim_drops"]))

@@ -32,7 +32,10 @@ _PINS_FILE = "pins.pkl"
 # Bump when the StoreState schema changes in a way load() must adapt to.
 # 7: span_tab empty sentinel 0 → _TAB_EMPTY (deterministic min-insert);
 #    ann_poison middle-host trust array added.
-_REVISION = 7
+# 8: key_claim_drops counter added — the negative-lookup gate's proof
+#    obligation. Snapshots predating it never counted drops, so their
+#    restores must keep the gate OFF (drops forced >= 1).
+_REVISION = 8
 
 
 def _dict_dump(d) -> list:
@@ -209,12 +212,31 @@ def load(path: str, mesh=None):
 
     data = np.load(os.path.join(path, _STATE_FILE))
     upd = {}
-    counters = {}
+    # Counters the snapshot predates keep their init defaults — the
+    # schema may grow counters (e.g. key_claim_drops) and ingest
+    # addresses them by name.
+    base_state = store.inner.states if n_shards else store.state
+    counters = dict(base_state.counters)
     for key in data.files:
         if key.startswith("counters."):
             counters[key.split(".", 1)[1]] = jax.numpy.asarray(data[key])
         else:
             upd[key] = jax.numpy.asarray(data[key])
+    # Drop snapshot counters the current schema no longer carries.
+    counters = {
+        k: v for k, v in counters.items() if k in base_state.counters
+    }
+    if meta.get("revision", 1) < 8:
+        # Pre-rev-8 stores never counted key-claim drops: a congested
+        # claim back then left a key with bucket entries but no record,
+        # which the negative-lookup gate would misread as "never
+        # indexed". Force the gate off for the restored store's
+        # lifetime.
+        counters["key_claim_drops"] = jax.numpy.maximum(
+            jax.numpy.asarray(counters["key_claim_drops"],
+                              jax.numpy.int64),
+            jax.numpy.int64(1),
+        )
     upd["counters"] = counters
     # Leaves the current schema no longer carries (e.g. the r2 watermark
     # dep_archived_gid, retired with the streaming hash join) are

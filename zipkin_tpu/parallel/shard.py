@@ -407,6 +407,7 @@ class ShardedSpanStore:
                         c.capacity, fam, min(limit, fam[3]),
                         (svc.astype(jnp.int32), name_lc.astype(jnp.int32)),
                         end_ts, st.key_tab, st.key_wm, st.write_pos,
+                        st.counters["key_claim_drops"],
                     )
                 else:
                     fam = lay[dev.StoreConfig.CAND_SVC]
@@ -444,6 +445,7 @@ class ShardedSpanStore:
                         c.capacity, fam, min(limit, fam[3]),
                         (svc32, ann.astype(jnp.int32)), end_ts,
                         st.key_tab, st.key_wm, st.write_pos,
+                        st.counters["key_claim_drops"],
                         st.ann_poison,
                     )
                 elif mode == "bkey":
@@ -454,6 +456,7 @@ class ShardedSpanStore:
                         c.capacity, fam, min(limit, fam[3]),
                         (svc32, bkey.astype(jnp.int32), jnp.int32(-1)),
                         end_ts, st.key_tab, st.key_wm, st.write_pos,
+                        st.counters["key_claim_drops"],
                         st.ann_poison,
                     )
                 else:
@@ -469,6 +472,7 @@ class ShardedSpanStore:
                         (svc32, bkey.astype(jnp.int32),
                          bval2.astype(jnp.int32)),
                         end_ts, st.key_tab, st.key_wm, st.write_pos,
+                        st.counters["key_claim_drops"],
                         st.ann_poison,
                     )
                 return mat[None], complete[None], wm[None]
@@ -781,6 +785,7 @@ class ShardedSpanStore:
                     b_base, s_base, n_b, depth, key1, key2, key3,
                     three, is_svc, end_ts, poison_on,
                     st.ann_poison, st.write_pos, st.key_tab, st.key_wm,
+                    st.counters["key_claim_drops"],
                 )
                 return mat[None], complete[None], wm[None]
 

@@ -471,6 +471,10 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
             "anns_seen": jnp.int64(0),
             "banns_seen": jnp.int64(0),
             "batches": jnp.int64(0),
+            # Keyed index rows whose per-key claim exhausted its probes
+            # (table congestion). While 0, an absent key record PROVES
+            # the key was never indexed — the negative-lookup gate.
+            "key_claim_drops": jnp.int64(0),
         },
     )
 
@@ -804,7 +808,11 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     ``key_tab``/``key_wm`` is the per-key cursor table (see
     StoreState.key_tab); rows with ``keyed`` claim a record for their
     verify word, and every displaced or in-batch-dropped keyed entry
-    scatter-maxes its span gid into its key's displaced watermark."""
+    scatter-maxes its span gid into its key's displaced watermark.
+    Also returns the number of keyed rows whose claim found no slot
+    (table congestion): while that count is ZERO over the store's
+    lifetime, an ABSENT record proves its key was never indexed — the
+    negative-lookup gate (see iquery wrappers)."""
     n_b = pos.shape[0]
     rank = _fifo_ranks(gbucket, valid, n_b)
     b_c = jnp.clip(gbucket, 0, n_b - 1)
@@ -869,7 +877,8 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
             disp_gid, mode="drop"
         )
         seen |= hit
-    return entries, pos, wm, key_tab, key_wm
+    n_drops = (ins_ok & ~placed).sum().astype(jnp.int64)
+    return entries, pos, wm, key_tab, key_wm, n_drops
 
 
 def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
@@ -1100,12 +1109,21 @@ def poison_ann_trust(state: "StoreState") -> "StoreState":
       pinned at I64_MAX so even a 2^-48 key48 collision with the
       tombstone pattern reads as untrusted."""
     wp = jnp.asarray(state.write_pos, jnp.int64)
+    counters = dict(state.counters)
+    # A tombstoned table must also kill the NEGATIVE gate (absent record
+    # ⇒ never indexed): pre-restore claims are lost, so absence proves
+    # nothing. A nonzero drop counter disables it permanently.
+    counters["key_claim_drops"] = jnp.maximum(
+        jnp.asarray(counters.get("key_claim_drops", 0), jnp.int64),
+        jnp.ones_like(wp),
+    )
     return state.replace(
         ann_poison=jnp.broadcast_to(
             wp[..., None], state.ann_poison.shape
         ).astype(jnp.int64),
         key_tab=jnp.full(state.key_tab.shape, I64_MIN, jnp.int64),
         key_wm=jnp.full(state.key_wm.shape, I64_MAX, jnp.int64),
+        counters=counters,
     )
 
 
@@ -1275,6 +1293,7 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     # -- index column families -----------------------------------------
     # (written before the counter block; the ann-derived columns below
     # are shared with the presence/top-annotation updates further down)
+    n_key_drops = jnp.int64(0)
     if c.use_index:
         lay, _, _ = c.cand_layout
         a_host = b.ann_service_id
@@ -1371,7 +1390,7 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
             ))
         cat = [jnp.concatenate(parts) for parts in zip(*segments)]
         (upd["cand_idx"], upd["cand_pos"], upd["cand_wm"],
-         upd["key_tab"], upd["key_wm"]) = _index_write(
+         upd["key_tab"], upd["key_wm"], n_key_drops) = _index_write(
             state.cand_idx, state.cand_pos, state.cand_wm,
             state.key_tab, state.key_wm, *cat
         )
@@ -1491,6 +1510,8 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         "anns_seen": state.counters["anns_seen"] + b.n_anns,
         "banns_seen": state.counters["banns_seen"] + b.n_banns,
         "batches": state.counters["batches"] + 1,
+        "key_claim_drops": state.counters["key_claim_drops"]
+        + n_key_drops,
     }
 
     return state.replace(**upd)
@@ -1726,7 +1747,7 @@ def _key_lookup_wm(key_tab, key_wm, mixed):
 def _iq_verify_impl(entries, pos, wm, row_gid, indexable, trace_id,
                     ts_last, capacity: int, layout, k: int,
                     key_parts, end_ts, key_tab, key_wm, write_pos,
-                    poison=None):
+                    key_drops, poison=None):
     b_base, s_base, n_b, depth = layout
     mixed = _mixb(list(key_parts))
     lb = _bucket_of(mixed, n_b)
@@ -1740,13 +1761,20 @@ def _iq_verify_impl(entries, pos, wm, row_gid, indexable, trace_id,
     # Per-key completeness: every entry this key ever LOST from its
     # bucket is already evicted from the ring, so the verify-matched
     # window rows are the key's full resident entry set — exact even
-    # when bucket-mates wrapped the bucket.
+    # when bucket-mates wrapped the bucket. Negative twin: while no
+    # claim was ever dropped, an ABSENT record proves the key was never
+    # indexed at all — the (empty) result is the true answer, the
+    # reference's instant empty-row read.
     kfound, kwmv = _key_lookup_wm(key_tab, key_wm, mixed)
-    key_complete = kfound & (kwmv < write_pos - capacity)
+    key_complete = (kfound & (kwmv < write_pos - capacity)) | (
+        ~kfound & (key_drops == 0)
+    )
     if poison is not None:
         # Middle-host distrust (see StoreState.ann_poison): while a
         # 3+-distinct-host span with key_parts[0] as a middle host is
-        # still resident, no completeness claim may be trusted.
+        # still resident, no completeness claim may be trusted — its
+        # middle-host entries (and their key claims) were never
+        # written, so even the absence proof doesn't hold.
         svc = jnp.clip(key_parts[0], 0, poison.shape[0] - 1)
         bad = poison[svc] >= write_pos - capacity
         cnt = jnp.where(bad, jnp.int64(depth + 1), cnt)
@@ -1763,7 +1791,8 @@ def _iq_verify_impl(entries, pos, wm, row_gid, indexable, trace_id,
 def _iq_verify2_impl(entries, pos, wm, row_gid, indexable, trace_id,
                      ts_last, capacity: int, layout, k: int,
                      key_parts1, key_parts2, end_ts,
-                     key_tab, key_wm, write_pos, poison=None):
+                     key_tab, key_wm, write_pos, key_drops,
+                     poison=None):
     b_base, s_base, n_b, depth = layout
     m1 = _mixb(list(key_parts1))
     m2 = _mixb(list(key_parts2))
@@ -1787,7 +1816,9 @@ def _iq_verify2_impl(entries, pos, wm, row_gid, indexable, trace_id,
     kf1, kw1 = _key_lookup_wm(key_tab, key_wm, m1)
     kf2, kw2 = _key_lookup_wm(key_tab, key_wm, m2)
     horizon = write_pos - capacity
-    key_complete = kf1 & kf2 & (kw1 < horizon) & (kw2 < horizon)
+    key_complete = (kf1 & kf2 & (kw1 < horizon) & (kw2 < horizon)) | (
+        ~kf1 & ~kf2 & (key_drops == 0)
+    )
     if poison is not None:
         svc = jnp.clip(key_parts1[0], 0, poison.shape[0] - 1)
         bad = poison[svc] >= horizon
@@ -1808,7 +1839,7 @@ def _iq_multi_impl(entries, pos, wm, row_gid, indexable, trace_id,
                    b_base, s_base, n_b, depth,
                    key1, key2, key3, three, is_svc,
                    end_ts, poison_on, poison, write_pos,
-                   key_tab, key_wm):
+                   key_tab, key_wm, key_drops):
     """N independent index-bucket probes in ONE launch.
 
     Every probe carries its own family geometry (b_base/s_base/n_b/
@@ -1863,7 +1894,10 @@ def _iq_multi_impl(entries, pos, wm, row_gid, indexable, trace_id,
     cnt = jnp.where(bad, depth.astype(jnp.int64) + 1, cnt)
     wmv = jnp.where(bad, jnp.int64(I64_MAX), wmv)
     kfound, kwmv = _key_lookup_wm(key_tab, key_wm, mixed)
-    key_complete = ~is_svc & ~bad & kfound & (kwmv < horizon)
+    key_complete = ~is_svc & ~bad & (
+        (kfound & (kwmv < horizon))
+        | (~kfound & (key_drops == 0))
+    )
     return mat, (cnt <= depth) | key_complete, wmv
 
 
@@ -1891,6 +1925,7 @@ def iquery_trace_ids_multi(state: StoreState, probes, k: int):
         jnp.asarray(probes["poison_on"], bool),
         state.ann_poison, state.write_pos,
         state.key_tab, state.key_wm,
+        state.counters["key_claim_drops"],
     )
 
 
@@ -1911,6 +1946,7 @@ def iquery_trace_ids_by_service(state: StoreState, svc_id, name_lc_id,
             c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(name_lc_id)), end_ts,
             state.key_tab, state.key_wm, state.write_pos,
+            state.counters["key_claim_drops"],
         )
     fam = lay[StoreConfig.CAND_SVC]
     return _iq_service_impl(
@@ -1936,7 +1972,7 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
             c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(ann_value_id)), end_ts,
             state.key_tab, state.key_wm, state.write_pos,
-            state.ann_poison,
+            state.counters["key_claim_drops"], state.ann_poison,
         )
     if bann_value_id is None or bann_value_id < 0:
         bann_value_id = -1
@@ -1957,7 +1993,7 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
             c.capacity, fam, min(k, fam[3]),
             (jnp.int32(svc_id), jnp.int32(bann_key_id), jnp.int32(-1)),
             end_ts, state.key_tab, state.key_wm, state.write_pos,
-            state.ann_poison,
+            state.counters["key_claim_drops"], state.ann_poison,
         )
     # The two-bucket probe's candidate window is 2*depth rows; clamping
     # k to depth would truncate valid candidates of never-wrapped
@@ -1972,7 +2008,7 @@ def iquery_trace_ids_by_annotation(state: StoreState, svc_id,
         (jnp.int32(svc_id), jnp.int32(bann_key_id),
          jnp.int32(bann_value_id2)),
         end_ts, state.key_tab, state.key_wm, state.write_pos,
-        state.ann_poison,
+        state.counters["key_claim_drops"], state.ann_poison,
     )
 
 
