@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Run the full TPU validation ladder the moment the axon tunnel answers.
+# Order matters: cheap compile probes first (fail fast, nothing queued),
+# then the full benchmark (which itself runs the kernel comparison and
+# the automatic 1B-span attempt). ONE process touches the TPU at a time
+# (NOTES_r03 §7) — do not run anything else against the chip while this
+# is in flight.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date +%Y%m%d_%H%M%S)
+OUT=/tmp/tpu_validation_$STAMP
+mkdir -p "$OUT"
+echo "== 1/3 pallas Mosaic compile probe =="
+timeout 600 python - <<'EOF' 2>&1 | tee "$OUT/pallas_probe.log"
+import jax, jax.numpy as jnp
+print("platform:", jax.devices()[0].platform)
+from zipkin_tpu.ops.pallas_kernels import flat_histogram
+import numpy as np
+idx = jnp.asarray(np.random.default_rng(0).integers(0, 2048, size=4096), jnp.int32)
+w = jnp.ones(4096, jnp.float32)
+out = flat_histogram(idx, w, 2048)
+print("pallas flat_histogram compiled+ran:", float(out.sum()))
+EOF
+echo "== 2/3 index exactness at bench shapes (quick stream) =="
+timeout 2400 python bench.py --spans 2e7 2>&1 | tee "$OUT/bench_quick.log" | tail -3
+echo "== 3/3 full benchmark (100M + compare + 1B attempt) =="
+timeout 14400 python bench.py 2>&1 | tee "$OUT/bench_full.log" | tail -3
+echo "artifacts in $OUT"
