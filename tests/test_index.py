@@ -207,9 +207,11 @@ def test_index_first_topk_gating():
     ids, scanned = run([(1, 100)], True, -1)
     assert [i.trace_id for i in ids] == [1] and not scanned
     # Complete + underfull + saturated window (k = limit*8 = 16
-    # candidates, all one trace): must scan.
+    # candidates, all one trace): retried at full depth — the fake
+    # fetch's unclamped window (k) then exceeds the candidate count, so
+    # the retry PROVES the underfull answer without a scan.
     ids, scanned = run([(1, 100 - i) for i in range(16)], True, -1)
-    assert scanned
+    assert [i.trace_id for i in ids] == [1] and not scanned
     # Wrapped + full + last candidate above the watermark: trusted.
     ids, scanned = run([(1, 100), (2, 90)], False, 50)
     assert [i.trace_id for i in ids] == [1, 2] and not scanned

@@ -247,7 +247,7 @@ def index_topk_or_none(limit: int, k: int, candidates, complete,
 
 
 def index_first_topk(limit: int, k_max: int, index_fetch,
-                     scan_fetch) -> List["IndexedTraceId"]:
+                     scan_fetch, stats=None) -> List["IndexedTraceId"]:
     """Index fast path with scan fallback, the shared read policy of the
     device stores. ``index_fetch(k)`` reads an O(depth) index bucket and
     returns (candidates, complete, watermark, window):
@@ -266,16 +266,37 @@ def index_first_topk(limit: int, k_max: int, index_fetch,
       window silently cut real candidates — the bug the 3-store oracle
       parity drive caught in the two-bucket binary-value probe).
 
+    A complete bucket whose top-k window saturated gets ONE retry at
+    full bucket depth (the kernel clamps the oversized request to its
+    geometry) — an O(depth) read that usually proves the answer without
+    the O(ring) scan when a hot key's entries crowd the window.
+
     Anything else falls back to the O(ring) scan kernel's escalation.
     Near-monotonic traffic (the normal case: spans arrive roughly in
     timestamp order) keeps wrapped buckets trusted; shuffled arrival
-    degrades to the scan, never to a wrong answer."""
+    degrades to the scan, never to a wrong answer.
+
+    ``stats`` (optional) is any object with ``index_hits`` /
+    ``index_fallbacks`` counters — the accounting hook /metrics reads
+    (TpuSpanStore passes itself)."""
     k = limit * 8
     candidates, complete, watermark, window = index_fetch(k)
     ids = index_topk_or_none(limit, min(k, window), candidates,
                              complete, watermark)
+    if (ids is None and complete and 0 < k <= window
+            and len(candidates) >= k):
+        # window >= k: the first read was top-k-truncated, not
+        # bucket-clamped — a full-depth reread can actually add rows.
+        k = 1 << 20
+        candidates, complete, watermark, window = index_fetch(k)
+        ids = index_topk_or_none(limit, min(k, window), candidates,
+                                 complete, watermark)
     if ids is not None:
+        if stats is not None:
+            stats.index_hits += 1
         return ids
+    if stats is not None:
+        stats.index_fallbacks += 1
     return topk_ids_with_escalation(limit, k_max, scan_fetch)
 
 

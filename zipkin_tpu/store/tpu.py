@@ -752,15 +752,8 @@ class TpuSpanStore(SpanStore):
 
     def _index_first(self, limit, k_max, index_fetch, scan_fetch):
         """index_first_topk with hit/fallback accounting (→ /metrics)."""
-        k = limit * 8
-        candidates, complete, wm, window = index_fetch(k)
-        ids = index_topk_or_none(limit, min(k, window), candidates,
-                                 complete, wm)
-        if ids is not None:
-            self.index_hits += 1
-            return ids
-        self.index_fallbacks += 1
-        return topk_ids_with_escalation(limit, k_max, scan_fetch)
+        return index_first_topk(limit, k_max, index_fetch, scan_fetch,
+                                stats=self)
 
     def get_trace_ids_by_annotation(
         self, service_name: str, annotation: str, value: Optional[bytes],
