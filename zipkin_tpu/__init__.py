@@ -30,7 +30,7 @@ if _os.environ.get("ZIPKIN_TPU_X64", "1") != "0":
 
     _jax.config.update("jax_enable_x64", True)
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 from zipkin_tpu.models.span import (  # noqa: F401
     Annotation,
