@@ -234,3 +234,74 @@ class TestShardedConcurrency:
         assert not errors, errors
         total = sum(len(b) for b in batches)
         assert store.stored_span_count() == float(total)
+
+
+class TestDecoderFuzz:
+    """Random and truncated byte soup into both decoders: corrupt input
+    is a VALID input class (kafka/scribe deliver it freely) — the
+    decoders must reject or truncate, never crash or hang."""
+
+    def _payloads(self, n=200):
+        import numpy as np
+
+        rng = np.random.default_rng(42)
+        from zipkin_tpu.tracegen import generate_traces
+
+        good = b"".join(
+            span_to_bytes(s)
+            for t in generate_traces(n_traces=3, max_depth=3)
+            for s in t
+        )
+        out = []
+        for i in range(n):
+            kind = i % 4
+            if kind == 0:  # pure noise
+                out.append(rng.bytes(int(rng.integers(1, 400))))
+            elif kind == 1:  # truncated valid payload
+                out.append(good[: int(rng.integers(1, len(good)))])
+            elif kind == 2:  # valid payload with flipped bytes
+                b = bytearray(good)
+                for _ in range(int(rng.integers(1, 12))):
+                    b[int(rng.integers(0, len(b)))] = int(
+                        rng.integers(0, 256))
+                out.append(bytes(b))
+            else:  # noise appended to valid
+                out.append(good + rng.bytes(int(rng.integers(1, 64))))
+        return out
+
+    def test_python_decoder_survives_fuzz(self):
+        decoded = rejected = 0
+        for payload in self._payloads():
+            try:
+                spans = spans_from_bytes(payload)
+                decoded += 1
+                for s in spans:  # decoded objects must be well-formed
+                    s.service_name
+                    hash(s)
+            except ThriftError:
+                rejected += 1
+        assert decoded + rejected == 200
+        assert rejected > 0  # the fuzz really produced garbage
+
+    def test_native_decoder_survives_fuzz(self):
+        from zipkin_tpu import native
+        from zipkin_tpu.columnar.dictionary import DictionarySet
+
+        if not native.available():
+            pytest.skip("native lib unavailable")
+        ok = bad = 0
+        for payload in self._payloads():
+            dicts = DictionarySet()
+            try:
+                batch, _, _, _ = native.parse_spans_columnar_sampled(
+                    payload, dicts, 0, max_spans=4096
+                )
+            except (ValueError, native.NativeUnavailable):
+                # ValueError covers ParseCapacityError; anything else
+                # (segfault-adjacent ctypes errors, assertion blowups)
+                # must FAIL the test, not count as a clean rejection.
+                bad += 1
+                continue
+            ok += 1
+            assert batch.n_spans >= 0
+        assert ok + bad == 200
