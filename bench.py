@@ -152,6 +152,12 @@ def _tpu_config(capacity_log2: int, n_services: int, use_pallas: bool):
         idx_name_buckets=(1 << 16) if big else 0,
         idx_name_depth=256 if big else 0,
         idx_key_slots=(1 << 22) if big else 0,
+        # One dependency bucket closes per half ring (~2M spans): 64
+        # time-tagged banks keep ~128M spans of windowed dependency
+        # resolution before older windows fold into the all-time tail
+        # (the hourly-Dependencies-rows fidelity at stream scale;
+        # +1.0GB at S=1024, within the 16GB budget).
+        dep_buckets=64 if big else 16,
     )
 
 
