@@ -33,27 +33,31 @@ def _interpret() -> bool:
 
 
 def _hist_kernel(idx_ref, w_ref, out_ref):
-    # idx_ref/w_ref are SMEM-resident (1, tile) blocks: SMEM is the TPU
-    # memory built for data-dependent SCALAR reads, so ``idx_ref[0, t]``
-    # with a loop-carried ``t`` lowers cleanly — the earlier VMEM
-    # variant's dynamic LANE index was what Mosaic rejected ("cannot
-    # statically prove index in dimension 2 is a multiple of 128",
-    # NOTES_r03.md §6). The output stays VMEM-resident across the whole
-    # grid (same block for every step); updates are row-granular
-    # read-modify-writes with a one-hot lane add — dynamic SUBLANE
-    # indexing is legal.
+    # idx_ref/w_ref are SMEM-resident rank-1 blocks of ``tile`` scalars:
+    # SMEM is the TPU memory built for data-dependent SCALAR reads, so
+    # ``idx_ref[t]`` with a loop-carried ``t`` lowers cleanly — the
+    # round-3 VMEM variant's dynamic LANE index was what Mosaic rejected
+    # ("cannot statically prove index in dimension 2 is a multiple of
+    # 128", NOTES_r03.md §6), and a rank-2 (1, tile) SMEM block trips
+    # the block-shape rule (second-to-last dim must be divisible by 8 or
+    # equal the array dim). Rank-1 blocks only constrain the LAST dim
+    # (tile % 128 == 0, asserted by the caller). The output stays
+    # VMEM-resident across the whole grid (same block for every step);
+    # updates are row-granular read-modify-writes with a one-hot lane
+    # add — dynamic SUBLANE indexing is legal.
     i = pl.program_id(0)
-    tile = idx_ref.shape[1]
+    tile = idx_ref.shape[0]
 
     @pl.when(i == 0)
     def _():
         out_ref[:, :] = jnp.zeros_like(out_ref)
 
-    # Shift/mask instead of //,% — LANES is 128 — and int32 loop bounds:
-    # pallas TPU has no 64-bit lowering, and x64 mode would make a plain
-    # python-int fori_loop index int64.
+    # Shift/mask instead of //,% — LANES is 128 — int32 loop bounds and
+    # a None carry: pallas TPU has no 64-bit lowering, and x64 mode
+    # would make a plain python-int bound or carry int64 (Mosaic then
+    # fails to legalize the loop's i64 func.return).
     def body(t, carry):
-        b = idx_ref[0, t]
+        b = idx_ref[t]
 
         @pl.when(b >= 0)
         def _():
@@ -61,12 +65,12 @@ def _hist_kernel(idx_ref, w_ref, out_ref):
             c = b & 127
             row = out_ref[pl.ds(r, 1), :]
             lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
-            onehot = (lane == c).astype(row.dtype) * w_ref[0, t]
+            onehot = (lane == c).astype(row.dtype) * w_ref[t]
             out_ref[pl.ds(r, 1), :] = row + onehot
 
         return carry
 
-    jax.lax.fori_loop(jnp.int32(0), jnp.int32(tile), body, 0)
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(tile), body, None)
 
 
 @functools.partial(jax.jit, static_argnames=("m", "tile"))
@@ -79,25 +83,30 @@ def flat_histogram(idx, weights, m: int, tile: int = DEFAULT_TILE):
     assert m % LANES == 0, "histogram size must be a multiple of 128"
     assert tile % LANES == 0, "tile must be a multiple of 128"
     n = idx.shape[0]
+    if n == 0:
+        # Zero-length SMEM operands fail Mosaic layout verification, and
+        # a (0,) grid would skip the i==0 output zeroing anyway.
+        return jnp.zeros(m, jnp.asarray(weights).dtype)
     n_tiles = -(-n // tile)
     pad = n_tiles * tile - n
     idx = jnp.pad(jnp.asarray(idx, jnp.int32), (0, pad), constant_values=-1)
     weights = jnp.pad(jnp.asarray(weights), (0, pad))
-    idx2 = idx.reshape(n_tiles, tile)
-    w2 = weights.reshape(n_tiles, tile)
+    # Index maps must return i32: with jax_enable_x64 on (package-wide),
+    # a literal python 0 traces as i64 and Mosaic fails to legalize the
+    # map's func.return. ``i - i`` stays in the i32 program-id type.
     out = pl.pallas_call(
         _hist_kernel,
         grid=(n_tiles,),
         in_specs=[
-            pl.BlockSpec((1, tile), lambda i: (i, 0),
+            pl.BlockSpec((tile,), lambda i: (i,),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, tile), lambda i: (i, 0),
+            pl.BlockSpec((tile,), lambda i: (i,),
                          memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((m // LANES, LANES), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((m // LANES, LANES), w2.dtype),
+        out_specs=pl.BlockSpec((m // LANES, LANES), lambda i: (i - i, i - i)),
+        out_shape=jax.ShapeDtypeStruct((m // LANES, LANES), weights.dtype),
         interpret=_interpret(),
-    )(idx2, w2)
+    )(idx, weights)
     return out.reshape(m)
 
 
