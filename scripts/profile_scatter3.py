@@ -1,0 +1,168 @@
+"""Round 3 of the scatter diagnosis: which primitive is fast on BIG
+(HBM-resident) targets? Everything chained in-program (K=16) and every
+output fully consumed (sum folded into the carry) so XLA can't DCE any
+arm — the flaw that understated the first arm profile.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import zipkin_tpu  # noqa: F401  x64 on
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 114688
+NI = 8 * P
+M = 1 << 23  # 8M-row big target
+CAP = 1 << 22
+K = 16
+
+
+def chain_timeit(name, step, init, reps=3):
+    @jax.jit
+    def run(carry):
+        def body(i, c):
+            return step(c, i)
+        return jax.lax.fori_loop(jnp.int32(0), jnp.int32(K), body, carry)
+
+    out = run(init)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(out)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        times.append(time.perf_counter() - t0)
+    print(f"{name:58s} {min(times) / K * 1e3:9.2f} ms/op", flush=True)
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    rng = np.random.default_rng(0)
+    floor_init = jnp.ones((8, 128), jnp.float32)
+    chain_timeit("floor (x*2+1)", lambda c, i: c * 2.0 + 1.0, floor_init)
+
+    eidx = jnp.asarray(rng.choice(M, size=NI, replace=False), jnp.int32)
+    v1 = jnp.asarray(rng.integers(0, 1 << 40, size=NI), jnp.int64)
+    big64 = jax.device_put(jnp.zeros(M + 1, jnp.int64))
+    big32 = jax.device_put(jnp.zeros(M + 1, jnp.int32))
+    bigf = jax.device_put(jnp.zeros(M + 1, jnp.float32))
+
+    # 1. scatter-ADD on big i64 target
+    chain_timeit(
+        "ADD i64 917k -> 8M",
+        lambda t, i: t.at[eidx].add(v1 ^ i.astype(jnp.int64),
+                                    mode="drop"),
+        big64,
+    )
+    # 2. scatter-ADD big i32
+    v1_32 = v1.astype(jnp.int32)
+    chain_timeit(
+        "ADD i32 917k -> 8M",
+        lambda t, i: t.at[eidx].add(v1_32 + i, mode="drop"),
+        big32,
+    )
+    # 3. scatter-ADD big f32
+    v1_f = (v1 & jnp.int64(0xFFFFF)).astype(jnp.float32)
+    chain_timeit(
+        "ADD f32 917k -> 8M",
+        lambda t, i: t.at[eidx].add(v1_f + i.astype(jnp.float32),
+                                    mode="drop"),
+        bigf,
+    )
+    # 4. scatter-SET i32 on big target
+    chain_timeit(
+        "SET i32 917k -> 8M (unique)",
+        lambda t, i: t.at[eidx].set(v1_32 + i, mode="drop",
+                                    unique_indices=True),
+        big32,
+    )
+    # 5. scatter-SET i64 1-D (reference point from round 2: ~100ns/row)
+    chain_timeit(
+        "SET i64 917k -> 8M (unique)",
+        lambda t, i: t.at[eidx].set(v1 ^ i.astype(jnp.int64),
+                                    mode="drop", unique_indices=True),
+        big64,
+    )
+    # 6. SET-via-ADD-delta: gather old, add (new - old), unique indices
+    def set_via_add(t, i):
+        new = v1 ^ i.astype(jnp.int64)
+        old = t[eidx]
+        return t.at[eidx].add(new - old, mode="drop",
+                              unique_indices=True)
+    chain_timeit("SET i64 917k via gather+ADD-delta", set_via_add, big64)
+
+    # 7. gather 917k from big i64
+    acc0 = jnp.zeros((), jnp.int64)
+    gsrc = jax.device_put(
+        jnp.asarray(rng.integers(0, 1 << 40, size=M), jnp.int64))
+    chain_timeit(
+        "gather 917k from 8M i64 (sum-consumed)",
+        lambda c, i: c + gsrc[(eidx + i) % M].sum(),
+        acc0,
+    )
+    # 8. true sort cost, output fully consumed
+    skey = jnp.asarray(rng.integers(0, 1 << 62, size=NI), jnp.int64)
+    chain_timeit(
+        "sort i64 917k (sum-consumed)",
+        lambda c, i: c + jnp.sort(skey ^ i.astype(jnp.int64)).sum(),
+        acc0,
+    )
+    chain_timeit(
+        "argsort i64 917k (sum-consumed)",
+        lambda c, i: c + jnp.argsort(skey ^ i.astype(jnp.int64)).sum(),
+        acc0,
+    )
+    chain_timeit(
+        "sort i64 114k (sum-consumed)",
+        lambda c, i: c + jnp.sort(skey[:P] ^ i.astype(jnp.int64)).sum(),
+        acc0,
+    )
+
+    # 9. ring write via two dynamic_update_slices (wrap-safe roll trick):
+    # roll batch so the wrap point is at the batch boundary, then 1 DUS
+    # when no wrap. Compare a col write P=114k.
+    ring = jax.device_put(jnp.zeros(CAP, jnp.int64))
+    colP = v1[:P]
+
+    def ring_dus(t, i):
+        start = (i.astype(jnp.int64) * P) % CAP
+        # single DUS with wrap handled by lax.rem start (P divides CAP
+        # here, the bench case: batches never straddle — clamp form)
+        return jax.lax.dynamic_update_slice(
+            t, colP ^ i.astype(jnp.int64), (start,))
+    chain_timeit("ring col write via DUS (114k i64)", ring_dus, ring)
+
+    # 10. masked-set variant of DUS: set only valid rows (pad rows must
+    # not write) — gather old window, where(mask), DUS back.
+    maskP = jnp.asarray(rng.random(P) < 0.98)
+
+    def ring_dus_masked(t, i):
+        start = (i.astype(jnp.int64) * P) % CAP
+        old = jax.lax.dynamic_slice(t, (start,), (P,))
+        merged = jnp.where(maskP, colP ^ i.astype(jnp.int64), old)
+        return jax.lax.dynamic_update_slice(t, merged, (start,))
+    chain_timeit("ring col write via masked DUS", ring_dus_masked, ring)
+
+    # 11. SET [N,3] i64 -> one flat ADD-delta on 3M flat rows
+    vals3 = jnp.stack([v1, v1 ^ 77, v1 ^ 123], axis=-1)
+    big3 = jax.device_put(jnp.zeros(((M + 1) * 3,), jnp.int64))
+
+    def set3_via_add(t, i):
+        new = (vals3 ^ i.astype(jnp.int64)).reshape(-1)
+        fidx = (3 * eidx[:, None]
+                + jnp.arange(3, dtype=jnp.int32)[None, :]).reshape(-1)
+        old = t[fidx]
+        return t.at[fidx].add(new - old, mode="drop",
+                              unique_indices=True)
+    chain_timeit("SET [917k,3] i64 via flat ADD-delta", set3_via_add,
+                 big3)
+
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

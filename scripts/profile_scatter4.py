@@ -1,0 +1,190 @@
+"""Round 4: pick the exact i64->i32 decomposition for the hot scatters.
+Chained (K=16), outputs consumed, floor printed for subtraction.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import zipkin_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 114688
+NI = 8 * P
+M = 1 << 23
+K = 16
+
+
+def chain_timeit(name, step, init, reps=3):
+    @jax.jit
+    def run(carry):
+        def body(i, c):
+            return step(c, i)
+        return jax.lax.fori_loop(jnp.int32(0), jnp.int32(K), body, carry)
+
+    out = run(init)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(out)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        times.append(time.perf_counter() - t0)
+    print(f"{name:58s} {min(times) / K * 1e3:9.2f} ms/op", flush=True)
+
+
+def b32(x):
+    """i64 array -> (..., 2) i32 bit-planes (free bitcast)."""
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def b64(x):
+    """(..., 2) i32 bit-planes -> i64 (free bitcast)."""
+    return jax.lax.bitcast_convert_type(x, jnp.int64)
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    rng = np.random.default_rng(0)
+    chain_timeit("floor", lambda c, i: c * 2.0 + 1.0,
+                 jnp.ones((8, 128), jnp.float32))
+
+    eidx = jnp.asarray(rng.choice(M, size=NI, replace=False), jnp.int32)
+    v1 = jnp.asarray(rng.integers(0, 1 << 62, size=NI), jnp.int64)
+    big64 = jax.device_put(jnp.zeros(M + 1, jnp.int64))
+
+    # A. bitcast planes, ONE 2-D i32 scatter of [N,2] rows
+    def set_2d(t, i):
+        planes = b32(t)                       # [M+1, 2] i32
+        vals = b32(v1 ^ i.astype(jnp.int64))  # [N, 2] i32
+        planes = planes.at[eidx].set(vals, mode="drop",
+                                     unique_indices=True)
+        return b64(planes)
+    chain_timeit("SET i64 917k: bitcast + one [N,2] i32 scatter",
+                 set_2d, big64)
+
+    # B. bitcast planes, TWO 1-D i32 scatters on strided slices
+    def set_planes(t, i):
+        planes = b32(t)
+        vals = b32(v1 ^ i.astype(jnp.int64))
+        lo = planes[:, 0].at[eidx].set(vals[:, 0], mode="drop",
+                                       unique_indices=True)
+        hi = planes[:, 1].at[eidx].set(vals[:, 1], mode="drop",
+                                       unique_indices=True)
+        return b64(jnp.stack([lo, hi], axis=-1))
+    chain_timeit("SET i64 917k: two strided 1-D i32 scatters",
+                 set_planes, big64)
+
+    # C. flat planes layout: target stored as i32[2*(M+1)], interleaved
+    bigflat = jax.device_put(jnp.zeros(2 * (M + 1), jnp.int32))
+
+    def set_flat(t, i):
+        vals = b32(v1 ^ i.astype(jnp.int64)).reshape(-1)  # [2N]
+        fidx = (2 * eidx[:, None] + jnp.arange(2, dtype=jnp.int32)
+                ).reshape(-1)
+        return t.at[fidx].set(vals, mode="drop", unique_indices=True)
+    chain_timeit("SET i64 917k: interleaved flat i32 (2N rows)",
+                 set_flat, bigflat)
+
+    # D. [N,3] i64 entries row: one [N,6] i32 scatter into [M,6]
+    vals3 = jnp.stack([v1, v1 ^ 77, v1 ^ 123], axis=-1)
+    big3 = jax.device_put(jnp.zeros((M + 1, 3), jnp.int64))
+
+    def set3_2d(t, i):
+        planes = b32(t).reshape(M + 1, 6)
+        vals = b32(vals3 ^ i.astype(jnp.int64)).reshape(NI, 6)
+        planes = planes.at[eidx].set(vals, mode="drop",
+                                     unique_indices=True)
+        return jax.lax.bitcast_convert_type(
+            planes.reshape(M + 1, 3, 2), jnp.int64)
+    chain_timeit("SET [917k,3] i64: one [N,6] i32 scatter", set3_2d,
+                 big3)
+
+    # E. small-target i64 scatter-max (the wm arrays): 917k -> 98k
+    NB = 98304
+    bidx = jnp.asarray(rng.integers(0, NB, size=NI), jnp.int32)
+    wm0 = jax.device_put(jnp.full(NB + 1, -(1 << 62), jnp.int64))
+    chain_timeit(
+        "MAX i64 917k -> 98k small target (current wm path)",
+        lambda t, i: t.at[bidx].max(v1 ^ i.astype(jnp.int64),
+                                    mode="drop"),
+        wm0,
+    )
+
+    # F. wm via sort+segment-max+unique set (sort key: bucket<<? no —
+    # lexsort-free: single key = bucket*2^40 + (val>>22) approx is
+    # lossy; do exact two-pass: sort by bucket only, segmax via cummax
+    # over runs of the gathered values)
+    def wm_sortseg(t, i):
+        v = v1 ^ i.astype(jnp.int64)
+        order = jnp.argsort(bidx)
+        sb = bidx[order]
+        sv = v[order]
+        first = jnp.concatenate(
+            [jnp.ones(1, bool), sb[1:] != sb[:-1]])
+        segid = jnp.cumsum(first.astype(jnp.int32)) - 1
+        # running max within segment: cummax reset at segment starts
+        neg = jnp.int64(-(1 << 62))
+        run = jax.lax.associative_scan(
+            jnp.maximum,
+            jnp.where(first, sv, jnp.maximum(sv, neg)))
+        # associative_scan(max) without reset is wrong across segments;
+        # instead compute segment max via scatter-free trick: reverse
+        # trick needs segment ops — fall back to a masked scan:
+        # max within segment = cummax of (value keyed by segid) using
+        # the monotone-segid property: cummax of (segid<<62 | ...) no.
+        # Pragmatic: one small i64 scatter-max over DEDUPED run ends is
+        # NB-bounded rows; measure gather+set of run-END rows instead:
+        nxt = jnp.concatenate([sb[1:], jnp.full(1, -7, sb.dtype)])
+        run_end = sb != nxt
+        tgt = jnp.where(run_end, sb, NB)
+        old = t[jnp.clip(tgt, 0, NB)]
+        merged = jnp.maximum(old, run)
+        planes = b32(t)
+        mv = b32(merged)
+        lo = planes[:, 0].at[tgt].set(mv[:, 0], mode="drop",
+                                      unique_indices=True)
+        hi = planes[:, 1].at[tgt].set(mv[:, 1], mode="drop",
+                                      unique_indices=True)
+        return b64(jnp.stack([lo, hi], axis=-1))
+    chain_timeit("MAX i64 917k -> 98k: sort+runend+i32 set (approx)",
+                 wm_sortseg, wm0)
+
+    # G. scatter-add i64 small target (pos/cnt are i32 already; check
+    # i64 counters)
+    chain_timeit(
+        "ADD i64 917k -> 98k small target",
+        lambda t, i: t.at[bidx].add(v1 ^ i.astype(jnp.int64),
+                                    mode="drop"),
+        wm0,
+    )
+
+    # H. lexsort-equivalent: single sort of (bucket<<42 | row) then
+    # gather — what _fifo_ranks already does; time segmented cummax via
+    # the sort order (the building block for exact wm)
+    def segmax_exact(c, i):
+        v = v1 ^ i.astype(jnp.int64)
+        order = jnp.argsort(
+            (bidx.astype(jnp.int64) << 42)
+            | jnp.arange(NI, dtype=jnp.int64))
+        sb = bidx[order]
+        sv = v[order]
+        first = jnp.concatenate([jnp.ones(1, bool), sb[1:] != sb[:-1]])
+        # exact segmented cummax: scan with reset via (flag, value) pair
+        def comb(a, b):
+            fa, va = a
+            fb, vb = b
+            return fa | fb, jnp.where(fb, vb, jnp.maximum(va, vb))
+        _, run = jax.lax.associative_scan(comb, (first, sv))
+        return c + run.sum()
+    chain_timeit("exact segmented cummax over 917k (assoc_scan pair)",
+                 segmax_exact, jnp.int64(0))
+
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""Round 5: the last unknowns before the ingest scatter rewrite —
+i32 scatter-min (fp-war viability), gather costs by dtype/layout, and
+the log-doubling segmented cummax.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import zipkin_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 114688
+NI = 8 * P
+M = 1 << 23
+K = 16
+
+
+def chain_timeit(name, step, init, reps=3):
+    @jax.jit
+    def run(carry):
+        def body(i, c):
+            return step(c, i)
+        return jax.lax.fori_loop(jnp.int32(0), jnp.int32(K), body, carry)
+
+    out = run(init)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(out)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        times.append(time.perf_counter() - t0)
+    print(f"{name:58s} {min(times) / K * 1e3:9.2f} ms/op", flush=True)
+
+
+def main():
+    print("backend:", jax.default_backend(), flush=True)
+    rng = np.random.default_rng(0)
+    chain_timeit("floor", lambda c, i: c * 2.0 + 1.0,
+                 jnp.ones((8, 128), jnp.float32))
+
+    eidx = jnp.asarray(rng.integers(0, M, size=NI), jnp.int32)
+    v32 = jnp.asarray(rng.integers(0, 1 << 30, size=NI), jnp.int32)
+    big32 = jax.device_put(
+        jnp.full(M + 1, (1 << 31) - 1, jnp.int32))
+
+    chain_timeit(
+        "MIN i32 917k -> 8M (dup indices)",
+        lambda t, i: t.at[eidx].min(v32 ^ i, mode="drop"),
+        big32,
+    )
+    chain_timeit(
+        "MAX i32 917k -> 8M (dup indices)",
+        lambda t, i: t.at[eidx].max(v32 ^ i, mode="drop"),
+        big32,
+    )
+    # smaller row count (the span_tab P-row case)
+    chain_timeit(
+        "MIN i32 114k -> 4M",
+        lambda t, i: t.at[eidx[:P] % (1 << 22)].min(v32[:P] ^ i,
+                                                    mode="drop"),
+        jax.device_put(jnp.full((1 << 22) + 1, (1 << 31) - 1, jnp.int32)),
+    )
+
+    # gathers
+    acc32 = jnp.zeros((), jnp.int64)
+    acc64 = jnp.zeros((), jnp.int64)
+    src32 = jax.device_put(
+        jnp.asarray(rng.integers(0, 1 << 30, size=M), jnp.int32))
+    src64 = jax.device_put(
+        jnp.asarray(rng.integers(0, 1 << 60, size=M), jnp.int64))
+    chain_timeit(
+        "gather i32 917k from 8M",
+        lambda c, i: c + src32[(eidx + i) % M].sum(),
+        acc32,
+    )
+    chain_timeit(
+        "gather i64 917k from 8M",
+        lambda c, i: c + src64[(eidx + i) % M].sum(),
+        acc64,
+    )
+    chain_timeit(
+        "gather i64-as-2-plane-i32 917k from 8M",
+        lambda c, i: c + jax.lax.bitcast_convert_type(
+            jax.lax.bitcast_convert_type(src64, jnp.int32)
+            .reshape(-1)[
+                (2 * ((eidx + i) % M))[:, None]
+                + jnp.arange(2, dtype=jnp.int32)[None, :]
+            ], jnp.int64).sum(),
+        acc64,
+    )
+    chain_timeit(
+        "gather i64 114k from 8M",
+        lambda c, i: c + src64[(eidx[:P] + i) % M].sum(),
+        acc64,
+    )
+
+    # log-doubling segmented cummax over 917k i64 (run-end extraction)
+    bidx = jnp.asarray(rng.integers(0, 98304, size=NI), jnp.int32)
+    v64 = jnp.asarray(rng.integers(0, 1 << 60, size=NI), jnp.int64)
+
+    def seg_logdouble(c, i):
+        order = jnp.argsort(bidx)
+        sb = bidx[order]
+        sv = (v64 ^ i.astype(jnp.int64))[order]
+        segid = sb  # sorted -> segment id IS the bucket
+        vals = sv
+        d = 1
+        while d < NI:
+            shifted = jnp.concatenate(
+                [jnp.full(d, jnp.int64(-(1 << 62))), vals[:-d]])
+            same = jnp.concatenate(
+                [jnp.zeros(d, bool), segid[d:] == segid[:-d]])
+            vals = jnp.where(same, jnp.maximum(vals, shifted), vals)
+            d *= 2
+        return c + vals.sum()
+
+    chain_timeit("segmax i64 917k: argsort+log-doubling (20 steps)",
+                 seg_logdouble, acc64)
+
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

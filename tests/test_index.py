@@ -388,7 +388,7 @@ def test_pre_rev7_snapshot_disables_key_table(tmp_path):
     import numpy as np
 
     from zipkin_tpu import checkpoint
-    from zipkin_tpu.store.device import I64_MIN
+    from zipkin_tpu.store.device import _FP_TOMB as tomb
 
     store = TpuSpanStore(_cfg(True))
     spans = [s for t in generate_traces(n_traces=6, max_depth=3,
@@ -411,12 +411,12 @@ def test_pre_rev7_snapshot_disables_key_table(tmp_path):
 
     restored = checkpoint.load(path)
     # Table tombstoned: every word is the un-claimable sentinel.
-    assert (np.asarray(restored.state.key_tab) == I64_MIN).all()
+    assert (np.asarray(restored.state.key_tab) == tomb).all()
     # New ingest can't resurrect key trust...
     more = [s for t in generate_traces(n_traces=4, max_depth=3,
                                        n_services=4) for s in t]
     restored.apply(more)
-    assert (np.asarray(restored.state.key_tab) == I64_MIN).all()
+    assert (np.asarray(restored.state.key_tab) == tomb).all()
     # ...and reads stay exact vs a never-snapshotted oracle.
     oracle = TpuSpanStore(_cfg(False))
     oracle.apply(spans)

@@ -35,7 +35,11 @@ _PINS_FILE = "pins.pkl"
 # 8: key_claim_drops counter added — the negative-lookup gate's proof
 #    obligation. Snapshots predating it never counted drops, so their
 #    restores must keep the gate OFF (drops forced >= 1).
-_REVISION = 8
+# 9: key_tab stores i32 fingerprints instead of exact i64 key words
+#    (the i64 claim war serialized on TPU; see device._index_write).
+#    Older tables are tombstoned on restore and the drop floor above
+#    extends to revision 8 snapshots.
+_REVISION = 9
 
 
 def _dict_dump(d) -> list:
@@ -226,12 +230,13 @@ def load(path: str, mesh=None):
     counters = {
         k: v for k, v in counters.items() if k in base_state.counters
     }
-    if meta.get("revision", 1) < 8:
-        # Pre-rev-8 stores never counted key-claim drops: a congested
-        # claim back then left a key with bucket entries but no record,
-        # which the negative-lookup gate would misread as "never
-        # indexed". Force the gate off for the restored store's
-        # lifetime.
+    if meta.get("revision", 1) < 9:
+        # Pre-rev-8 stores never counted key-claim drops, and rev-8
+        # tables stored exact key words that the rev-9 fingerprint
+        # schema tombstones on restore (see below): either way a key
+        # may have bucket entries but no record, which the negative-
+        # lookup gate would misread as "never indexed". Force the gate
+        # off for the restored store's lifetime.
         counters["key_claim_drops"] = jax.numpy.maximum(
             jax.numpy.asarray(counters["key_claim_drops"],
                               jax.numpy.int64),
@@ -247,6 +252,23 @@ def load(path: str, mesh=None):
     known = set(dev.StoreState._FIELDS)
     revision = meta.get("revision", 1)
     legacy = revision < 4
+    if revision < 9 and "key_tab" in upd:
+        # Revisions < 9 stored exact 64-bit key words; the table is now
+        # 31-bit fingerprints (i32). The packed words are recoverable
+        # (fp31 of the stored key48), but the claim-is-first-record
+        # invariant can't be re-certified across the schema change, so
+        # tombstone the table (INT32_MIN: unclaimable, matches no
+        # fingerprint) and let load()'s pre-rev-8 drop-counter floor
+        # keep the negative gate off; bucket gates serve as before.
+        upd["key_tab"] = jax.numpy.full(
+            np.asarray(upd["key_tab"]).shape, dev._FP_TOMB,
+            jax.numpy.int32,
+        )
+        if "key_wm" in upd:
+            upd["key_wm"] = jax.numpy.full(
+                np.asarray(upd["key_wm"]).shape, dev.I64_MAX,
+                jax.numpy.int64,
+            )
     # Snapshots predating (parts of) the index families — or carrying
     # the pre-unification per-family layout — would restore empty
     # buckets whose zero cursors claim completeness, hiding every

@@ -230,6 +230,179 @@ def _pack_layout(fams):
     return tuple(out), b_base, s_base
 
 
+# -- fast scatter primitives -------------------------------------------------
+#
+# Measured on the real chip (scripts/profile_scatter*.py, round 4): any
+# 64-bit scatter (set/add/min/max) on this backend serializes at
+# ~100-125 ns/row — a 917k-row index write costs ~100 ms — while 1-D
+# int32 scatter-set with unique indices vectorizes at ~4.5 ns/row, and
+# 2-D scatters are slow in EVERY dtype. Sorts and elementwise i64 math
+# are cheap. So the hot ingest writes route through these helpers:
+# bitcast i64 arrays to two i32 bit-planes and issue two strided 1-D
+# unique scatters (10.4 ms vs 116 ms for 917k rows into 8M, measured).
+# Callers must guarantee uniqueness among the surviving (ok) indices;
+# dropped rows are remapped to DISTINCT out-of-bounds slots so the
+# promise holds for the whole index vector.
+
+
+def _p32(x):
+    """i64[...] -> i32[..., 2] bit-planes (free bitcast)."""
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _p64(p):
+    """i32[..., 2] bit-planes -> i64[...] (free bitcast)."""
+    return jax.lax.bitcast_convert_type(p, jnp.int64)
+
+
+def _oob_unique(idx, ok, n_rows: int):
+    """Remap ~ok rows to distinct OOB indices (>= n_rows) so a dropping
+    scatter may honestly claim unique_indices."""
+    n = idx.shape[0]
+    return jnp.where(
+        ok, idx.astype(jnp.int32),
+        jnp.int32(n_rows) + jnp.arange(n, dtype=jnp.int32),
+    )
+
+
+def _uset(arr, idx, vals, ok):
+    """arr.at[idx[ok]].set(vals[ok]) for a 1-D arr of any dtype; indices
+    must be unique among ok rows. i64 goes via two i32 plane scatters
+    (the only fast 64-bit scatter on this backend); other dtypes scatter
+    directly with the uniqueness promise."""
+    safe = _oob_unique(idx, ok, arr.shape[0])
+    if arr.dtype == jnp.int64:
+        p = _p32(arr)
+        v = _p32(jnp.asarray(vals, jnp.int64))
+        lo = p[:, 0].at[safe].set(v[:, 0], mode="drop",
+                                  unique_indices=True)
+        hi = p[:, 1].at[safe].set(v[:, 1], mode="drop",
+                                  unique_indices=True)
+        return _p64(jnp.stack([lo, hi], axis=-1))
+    return arr.at[safe].set(jnp.asarray(vals, arr.dtype), mode="drop",
+                            unique_indices=True)
+
+
+def _uset_cols64(arr, idx, vals, ok):
+    """Row scatter ``arr.at[idx[ok]].set(vals[ok])`` for an [M, C] i64
+    array via 2C strided 1-D i32 plane scatters (2-D scatters are slow
+    in every dtype on this backend; 1-D unique i32 is ~4.5 ns/row)."""
+    m, ncols = arr.shape
+    p = _p32(arr)                          # [M, C, 2]
+    v = _p32(jnp.asarray(vals, jnp.int64))  # [N, C, 2]
+    safe = _oob_unique(idx, ok, m)
+    planes = []
+    for cdx in range(ncols):
+        for pl in range(2):
+            planes.append(p[:, cdx, pl].at[safe].set(
+                v[:, cdx, pl], mode="drop", unique_indices=True))
+    return _p64(jnp.stack(planes, axis=-1).reshape(m, ncols, 2))
+
+
+# Per-key record table: i32 fingerprints (claims ride the vectorized
+# duplicate-index i32 scatter-min; see _index_write). 0x7FFFFFFF is the
+# empty sentinel — it loses every min-war and _fp31 never produces it.
+# INT32_MIN is the restore tombstone: unclaimable (wins every min-war)
+# and outside _fp31's range, so it matches no lookup (poison_ann_trust,
+# checkpoint rev<9 migration).
+_FP_EMPTY = jnp.int32(0x7FFFFFFF)
+_FP_TOMB = jnp.int32(-0x80000000)
+_KEY_PROBES = 2
+
+
+def _fp31(k48):
+    """48-bit key -> 31-bit non-negative fingerprint (never _FP_EMPTY).
+    Uses the key's top bits — _tab_slots consumes the low bits for the
+    probe sequence, so slot and fingerprint stay independent."""
+    f = (k48 >> jnp.uint64(17)).astype(jnp.int32) & jnp.int32(0x7FFFFFFF)
+    return jnp.minimum(f, jnp.int32(0x7FFFFFFE))
+
+
+def _seg_reduce_sorted(segid, vals, op, identity):
+    """Running segmented reduce over rows SORTED by segid (log-doubling:
+    ~20 shifted elementwise steps, all vectorized — i64 elementwise is
+    fine on this backend, only i64 SCATTER is serialized). Returns the
+    running reduction; row i holds op over its segment's rows <= i, so
+    each segment's LAST row holds the full segment reduction."""
+    n = vals.shape[0]
+    d = 1
+    while d < n:
+        shifted = jnp.concatenate(
+            [jnp.full(d, identity, vals.dtype), vals[:-d]])
+        same = jnp.concatenate(
+            [jnp.zeros(d, bool), segid[d:] == segid[:-d]])
+        vals = jnp.where(same, op(vals, shifted), vals)
+        d *= 2
+    return vals
+
+
+def _unsort_i32(order, svals, fill=0):
+    """Scatter sorted-space i32 values back to original row order (the
+    permutation is unique by construction)."""
+    n = order.shape[0]
+    return jnp.full(n, fill, jnp.int32).at[order].set(
+        svals, unique_indices=True)
+
+
+def _slot_war(slot, packed, active, n_slots: int):
+    """Explicit arbitration replacing a read-back scatter-min war: among
+    ``active`` rows contending for the same slot, the numerically
+    smallest ``packed`` wins — bitwise the same outcome as the old
+    ``.at[slot].min(packed)`` + re-read, but built from sorts and
+    elementwise ops (i64 scatters serialize at ~100 ns/row on this
+    backend; sorts are nearly free — scripts/profile_scatter*.py).
+
+    Returns (seg_min, write_row), both in ORIGINAL row order:
+    ``seg_min`` is the minimum packed offered at the row's slot this
+    round (I64_MAX for inactive rows), ``write_row`` marks exactly one
+    row per contended slot (safe for a unique scatter)."""
+    n = packed.shape[0]
+    s = jnp.where(active, slot.astype(jnp.int32), jnp.int32(n_slots))
+    # Lexicographic (slot, packed) via two stable argsorts.
+    ord1 = jnp.argsort(packed, stable=True)
+    ord2 = jnp.argsort(s[ord1], stable=True)
+    order = ord1[ord2]
+    ss = s[order]
+    sp = jnp.where(active[order], packed[order], I64_MAX)
+    # Sorted ascending by packed within each slot run, so the running
+    # segmented min broadcasts the winner's word to every row of a run.
+    seg_min_sorted = _seg_reduce_sorted(ss, sp, jnp.minimum, I64_MAX)
+    first = jnp.concatenate([jnp.ones(1, bool), ss[1:] != ss[:-1]])
+    write_sorted = first & active[order]
+    inv = jnp.argsort(order)  # unsort permutation
+    seg_min = seg_min_sorted[inv]
+    write_row = _unsort_i32(order, write_sorted.astype(jnp.int32)) > 0
+    return seg_min, write_row
+
+
+_LO_FLIP = jnp.int32(-0x80000000)  # sign-flip: u32 order as i32 order
+
+
+def _war_max64(arr, idx, vals, ok):
+    """``arr.at[idx[ok]].max(vals[ok])`` for an i64 WATERMARK array via
+    two independent i32 plane max-wars (duplicate indices allowed — i32
+    scatter-max vectorizes at ~9 ns/row on this backend; i64 serializes
+    at ~100 ns/row).
+
+    CONSERVATIVE, not exact: the result is elementwise
+    (max of hi planes, max of lo planes), which equals the true i64 max
+    unless two contenders straddle a 2^32 boundary in the same war —
+    then the stored value can only be LARGER than the true max. Every
+    caller is a watermark where overstatement means extra exactness
+    fallbacks, never a wrong answer (and understatement is impossible:
+    both wars only raise). The lo plane is sign-flipped so unsigned
+    32-bit order matches i32 compare; I64_MIN's planes are INT32_MIN
+    twice under the flip, losing every war — the empty sentinel."""
+    p = _p32(arr)
+    v = _p32(jnp.asarray(vals, jnp.int64))
+    safe = jnp.where(ok, idx.astype(jnp.int32), arr.shape[0])
+    lo_off = jnp.where(ok, v[:, 0] ^ _LO_FLIP, _LO_FLIP)
+    hi_off = jnp.where(ok, v[:, 1], _LO_FLIP)
+    lo = (p[:, 0] ^ _LO_FLIP).at[safe].max(lo_off, mode="drop") ^ _LO_FLIP
+    hi = p[:, 1].at[safe].max(hi_off, mode="drop")
+    return _p64(jnp.stack([lo, hi], axis=-1))
+
+
 def _ring(n, dtype, fill=0):
     return jnp.full((n,), fill, dtype)
 
@@ -337,17 +510,22 @@ class StoreState:
     # capacity) — the same displaced-gid gate as tr_wm, self-healing as
     # the ring turns over.
     ann_poison: jnp.ndarray  # [S] i64, I64_MIN = never poisoned
-    # Per-key cursor table (the device rendition of Cassandra's per-key
-    # index rows, cassandra-schema.txt:4-8): open addressing keyed by
-    # the candidate families' verify word. key_wm[slot] is the max span
-    # gid of an entry ever DISPLACED from the key's bucket window; a
-    # query whose key record shows key_wm < write_pos - capacity holds
-    # every RESIDENT entry of that key in the bucket window — complete
-    # even when bucket-mates wrapped the bucket (the sparse-key aliasing
-    # fallback of NOTES_r03 §4). Claim-on-empty ONLY, never stolen: an
-    # absent record (congestion) degrades to the per-bucket gates, never
-    # to a wrong answer.
-    key_tab: jnp.ndarray  # [T] i64 — (key48 << 16) | 1; _TAB_EMPTY empty
+    # Per-key record table (the device rendition of Cassandra's per-key
+    # index rows, cassandra-schema.txt:4-8): open addressing keyed by a
+    # 31-bit FINGERPRINT of the candidate families' verify word (i32 —
+    # duplicate-index i32 scatter-min vectorizes on this backend where
+    # the exact i64 word war serialized at ~100 ns/row). key_wm[slot] is
+    # the max span gid attributed to an entry ever DISPLACED from a
+    # recorded key's bucket window; a query whose key record shows
+    # key_wm < write_pos - capacity holds every RESIDENT entry of that
+    # key in the bucket window — complete even when bucket-mates wrapped
+    # the bucket (the sparse-key aliasing fallback of NOTES_r03 §4).
+    # Claim-on-empty ONLY, never stolen. Distinct keys may share a
+    # (slot, fingerprint) — they then share a record and their
+    # watermarks merge, which only OVERSTATES (extra fallbacks, never a
+    # wrong answer); an absent record (congestion) degrades to the
+    # per-bucket gates the same way.
+    key_tab: jnp.ndarray  # [T] i32 — fp31(key48); _FP_EMPTY empty
     key_wm: jnp.ndarray  # [T] i64 — max displaced gid; I64_MIN none
     svc_hist: jnp.ndarray  # [S, B] f32 — per-service duration log-histogram
     svc_span_counts: jnp.ndarray  # [S] f32
@@ -451,7 +629,7 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         tr_pos=jnp.zeros(c.trace_layout[1], jnp.int64),
         tr_wm=jnp.full(c.trace_layout[1], I64_MIN, jnp.int64),
         ann_poison=jnp.full(S, I64_MIN, jnp.int64),
-        key_tab=jnp.full(c.key_slots, _TAB_EMPTY, jnp.int64),
+        key_tab=jnp.full(c.key_slots, _FP_EMPTY, jnp.int32),
         key_wm=jnp.full(c.key_slots, I64_MIN, jnp.int64),
         svc_hist=Q.init(
             shape=(S,), n_buckets=c.quantile_buckets, alpha=c.quantile_alpha,
@@ -735,20 +913,27 @@ def _tab_insert(tab, key48, svc, valid):
     packed = _tab_pack(key48, svc)
     placed = ~jnp.asarray(valid, bool)
     slots = _tab_slots(key48, tab.shape[0])
+    # Each round's min-war is arbitrated EXPLICITLY (_slot_war sorts the
+    # contenders) instead of by an i64 scatter-min + re-read — bitwise
+    # the same winner (numerically smallest packed word), but built
+    # from sorts and one unique plane scatter (i64 scatters serialize
+    # at ~100 ns/row on this backend, profile_scatter*.py).
     for slot in slots:
-        cur = tab[slot].astype(jnp.uint64)
-        open_ = (cur == jnp.uint64(_TAB_EMPTY)) | (
-            (cur >> jnp.uint64(16)) == key48
+        cur = tab[slot]
+        curu = cur.astype(jnp.uint64)
+        open_ = (curu == jnp.uint64(_TAB_EMPTY)) | (
+            (curu >> jnp.uint64(16)) == key48
         )
         attempt = ~placed & open_
-        tab = tab.at[jnp.where(attempt, slot, oob)].min(packed, mode="drop")
-        after = tab[slot].astype(jnp.uint64)
-        placed |= attempt & ((after >> jnp.uint64(16)) == key48)
-    # Last-resort steal: clear, then MIN — so even same-slot stealers
-    # tie-break deterministically instead of by scatter order.
-    steal = jnp.where(placed, oob, slots[-1])
-    tab = tab.at[steal].set(jnp.int64(_TAB_EMPTY), mode="drop")
-    return tab.at[steal].min(packed, mode="drop")
+        seg_min, write_row = _slot_war(slot, packed, attempt, oob)
+        after = jnp.minimum(cur, seg_min)  # inactive rows: seg_min=MAX
+        tab = _uset(tab, slot, after, write_row)
+        placed |= attempt & (
+            (after.astype(jnp.uint64) >> jnp.uint64(16)) == key48)
+    # Last-resort steal: the old state is discarded, so the winner is
+    # simply the smallest packed word among same-slot stealers.
+    seg_min, write_row = _slot_war(slots[-1], packed, ~placed, oob)
+    return _uset(tab, slots[-1], seg_min, write_row)
 
 
 # -- index column families ---------------------------------------------------
@@ -786,7 +971,9 @@ def _fifo_ranks(bucket, valid, n_buckets: int):
     first = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
     idxs = jnp.arange(n, dtype=jnp.int32)
     start = jax.lax.cummax(jnp.where(first, idxs, jnp.int32(-1)))
-    return jnp.zeros(n, jnp.int32).at[order].set(idxs - start)
+    rank = jnp.zeros(n, jnp.int32).at[order].set(idxs - start,
+                                                 unique_indices=True)
+    return rank
 
 
 def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
@@ -820,63 +1007,75 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
         1, mode="drop")[:n_b]
     keep = valid & (rank >= cnt[b_c] - depth)
-    slot = slot0 + ((pos[b_c] + rank) % depth)
-    idx = jnp.where(keep, slot, entries.shape[0])
-    old = entries[jnp.clip(idx, 0, entries.shape[0] - 1)]
-    old_ts = jnp.where(keep & (old[:, 0] >= 0), old[:, 2], I64_MIN)
+    # Cursor math runs in the i32 low plane: depths are powers of two
+    # (StoreConfig._derived), so (pos + rank) % depth only needs the low
+    # 32 bits, and the occupancy test only needs pos itself, which stays
+    # far below 2^31 per bucket (total entries ever / n_buckets).
+    pos_lo = _p32(pos)[:, 0]
+    pos_b = pos_lo[b_c]
+    slot = slot0.astype(jnp.int32) + ((pos_b + rank) % depth)
+    # A kept write DISPLACES a previous entry iff its bucket has already
+    # wrapped past this slot — pos + rank >= depth — which replaces the
+    # old occupancy gather (old gid >= 0) exactly.
+    occupied = keep & (pos_b + rank >= depth)
+    gidx = jnp.where(keep, slot, 0)
+    old_verify = entries[:, 1][gidx]
+    old_ts = jnp.where(occupied, entries[:, 2][gidx], I64_MIN)
     dropped_ts = jnp.where(valid & ~keep, jnp.asarray(ts, jnp.int64),
                            I64_MIN)
-    wm = wm.at[oob_b].max(jnp.maximum(old_ts, dropped_ts), mode="drop")
+    wm = _war_max64(wm, oob_b, jnp.maximum(old_ts, dropped_ts), valid)
     gid = jnp.asarray(gid, jnp.int64)
     verify = jnp.asarray(verify, jnp.int64)
     vals = jnp.stack([gid, verify, jnp.asarray(ts, jnp.int64)], axis=-1)
-    entries = entries.at[idx].set(vals, mode="drop")
-    pos = pos.at[oob_b].add(1, mode="drop")
+    entries = _uset_cols64(entries, slot, vals, keep)
+    pos = pos + cnt.astype(pos.dtype)
 
-    # -- per-key cursor table ------------------------------------------
-    # 1. Claim records for this batch's keys: empty slots only, scatter-
-    #    MIN arbitration (all contenders for a slot resolve to the
-    #    numerically smallest word, deterministically). NEVER stolen and
-    #    never seeded on occupied-by-foreign probes: a key that fails
-    #    every probe simply has no record, which queries treat as
-    #    "unknown — use the bucket gates". Claim-with-clean-watermark is
-    #    sound precisely because records are immortal: a key that ever
-    #    failed to claim keeps failing (slots only fill), so a fresh
-    #    claim really is the key's first record.
+    # -- per-key fingerprint records -----------------------------------
+    # 1. Claim records for this batch's keys: empty slots only, i32
+    #    fingerprint min-war arbitration (duplicate-index i32 scatters
+    #    vectorize; the old exact-word i64 war serialized at ~100 ns/row
+    #    and dominated the whole ingest step). Records are NEVER stolen
+    #    and never seeded on occupied-by-foreign probes. Two distinct
+    #    keys may share (slot, fingerprint) — then they SHARE a record
+    #    and their displaced watermarks merge, which can only overstate
+    #    a watermark: extra fallbacks, never a wrong answer. The
+    #    negative-lookup gate stays sound: an indexed key either placed
+    #    a record its probes will find (fp match) or counted a drop.
     T = key_tab.shape[0]
     ins_ok = valid & jnp.asarray(keyed, bool)
     k48n = verify.astype(jnp.uint64) >> jnp.uint64(16)
-    packed = ((k48n << jnp.uint64(16)) | jnp.uint64(1)).astype(jnp.int64)
+    fp = _fp31(k48n)
     placed = ~ins_ok
-    for kslot in _tab_slots(k48n, T):
-        cur = key_tab[kslot].astype(jnp.uint64)
-        open_ = (cur == jnp.uint64(_TAB_EMPTY)) | (
-            (cur >> jnp.uint64(16)) == k48n
-        )
+    for kslot in _tab_slots(k48n, T)[:_KEY_PROBES]:
+        cur = key_tab[kslot]
+        open_ = (cur == _FP_EMPTY) | (cur == fp)
         attempt = ~placed & open_
         key_tab = key_tab.at[jnp.where(attempt, kslot, T)].min(
-            packed, mode="drop"
+            jnp.where(attempt, fp, _FP_EMPTY), mode="drop"
         )
-        after = key_tab[kslot].astype(jnp.uint64)
-        placed |= attempt & ((after >> jnp.uint64(16)) == k48n)
-    # 2. Record displacements: bucket-wrap victims carry their OLD
-    #    entry's (verify, gid); in-batch overflow drops carry their own.
+        after = key_tab[kslot]
+        placed |= attempt & (after == fp)
+    # 2. Record displacements: bucket-wrap victims are attributed to the
+    #    displaced entry's key (old verify); in-batch overflow drops to
+    #    their own key. The recorded gid is the CURRENT row's gid — an
+    #    upper bound on the displaced entry's gid (it is always older),
+    #    so the eviction gate fires at most one ring lap later than the
+    #    exact value would allow: conservative, and it saves the old-gid
+    #    gather (i64 gathers cost ~25 ns/row here).
     disp_ok = jnp.asarray(keyed, bool) & (
-        (keep & (old[:, 0] >= 0)) | (valid & ~keep)
+        (keep & occupied) | (valid & ~keep)
     )
-    disp_key = jnp.where(keep, old[:, 1], verify)
-    disp_gid = jnp.where(keep, old[:, 0], gid)
+    disp_key = jnp.where(keep, old_verify, verify)
     k48d = disp_key.astype(jnp.uint64) >> jnp.uint64(16)
-    seen = jnp.zeros(k48d.shape, bool)
-    for kslot in _tab_slots(k48d, T):
-        cur = key_tab[kslot].astype(jnp.uint64)
-        hit = disp_ok & ~seen & (cur != jnp.uint64(_TAB_EMPTY)) & (
-            (cur >> jnp.uint64(16)) == k48d
-        )
-        key_wm = key_wm.at[jnp.where(hit, kslot, T)].max(
-            disp_gid, mode="drop"
-        )
-        seen |= hit
+    fpd = _fp31(k48d)
+    dslot = jnp.full(k48d.shape, T, jnp.int32)
+    dfound = jnp.zeros(k48d.shape, bool)
+    for kslot in _tab_slots(k48d, T)[:_KEY_PROBES]:
+        cur = key_tab[kslot]
+        hit = ~dfound & (cur == fpd)
+        dslot = jnp.where(hit, kslot, dslot)
+        dfound |= hit
+    key_wm = _war_max64(key_wm, dslot, gid, disp_ok & dfound)
     n_drops = (ins_ok & ~placed).sum().astype(jnp.int64)
     return entries, pos, wm, key_tab, key_wm, n_drops
 
@@ -898,16 +1097,19 @@ def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
     cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
         1, mode="drop")[:n_b]
     keep = valid & (rank >= cnt[b_c] - depth)
-    slot = slot0 + ((pos[b_c] + rank) % depth)
-    idx = jnp.where(keep, slot, entries.shape[0])
-    old = entries[jnp.clip(idx, 0, entries.shape[0] - 1)]
-    old_gid = jnp.where(keep & (old >= 0), old, I64_MIN)
-    dropped_gid = jnp.where(valid & ~keep, jnp.asarray(gid, jnp.int64),
-                            I64_MIN)
-    wm = wm.at[oob_b].max(jnp.maximum(old_gid, dropped_gid), mode="drop")
-    entries = entries.at[idx].set(jnp.asarray(gid, jnp.int64),
-                                  mode="drop")
-    pos = pos.at[oob_b].add(1, mode="drop")
+    # i32 low-plane cursor math + gather-free displacement test, exactly
+    # as in _index_write. The recorded watermark gid is the CURRENT
+    # row's gid — an upper bound on the displaced entry's (older) gid,
+    # so the exactness gate fires at most one ring lap late
+    # (conservative; saves the i64 old-entry gather).
+    pos_lo = _p32(pos)[:, 0]
+    pos_b = pos_lo[b_c]
+    slot = slot0.astype(jnp.int32) + ((pos_b + rank) % depth)
+    occupied = keep & (pos_b + rank >= depth)
+    gid = jnp.asarray(gid, jnp.int64)
+    wm = _war_max64(wm, oob_b, gid, occupied | (valid & ~keep))
+    entries = _uset(entries, slot, gid, keep)
+    pos = pos + cnt.astype(pos.dtype)
     return entries, pos, wm
 
 
@@ -1103,11 +1305,12 @@ def poison_ann_trust(state: "StoreState") -> "StoreState":
       NOT hold across the restore boundary — pre-restore displacement
       history is lost, so a post-restore claim could certify a window
       missing displaced-but-resident restored spans. Permanently
-      disable the table with a tombstone word (I64_MIN: scatter-MIN can
-      never overwrite it, so claims always fail → absent records →
-      bucket gates serve, exactly the pre-upgrade behavior); key_wm is
-      pinned at I64_MAX so even a 2^-48 key48 collision with the
-      tombstone pattern reads as untrusted."""
+      disable the table with a tombstone fingerprint (INT32_MIN: the
+      i32 min-war can never overwrite it and _fp31 never produces it,
+      so claims always fail → absent records → bucket gates serve,
+      exactly the pre-upgrade behavior); key_wm is pinned at I64_MAX
+      so even a fingerprint collision with the tombstone pattern reads
+      as untrusted."""
     wp = jnp.asarray(state.write_pos, jnp.int64)
     counters = dict(state.counters)
     # A tombstoned table must also kill the NEGATIVE gate (absent record
@@ -1121,7 +1324,7 @@ def poison_ann_trust(state: "StoreState") -> "StoreState":
         ann_poison=jnp.broadcast_to(
             wp[..., None], state.ann_poison.shape
         ).astype(jnp.int64),
-        key_tab=jnp.full(state.key_tab.shape, I64_MIN, jnp.int64),
+        key_tab=jnp.full(state.key_tab.shape, _FP_TOMB, jnp.int32),
         key_wm=jnp.full(state.key_wm.shape, I64_MAX, jnp.int64),
         counters=counters,
     )
@@ -1215,48 +1418,62 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     PA = b.ann_ts.shape[0]
     PB = b.bann_key_id.shape[0]
 
+    # The ring writes assert unique_indices to XLA (duplicate slots
+    # would be silent state corruption, not just nondeterminism). The
+    # uniqueness invariant is on VALID rows only — n_spans <= capacity,
+    # n_anns <= ann_capacity, n_banns <= bann_capacity, pending count
+    # <= pending_slots — which are dynamic values the host chunker
+    # enforces per batch (TpuSpanStore.write_batch raises on violation,
+    # store/tpu.py). Padded rows past the valid count are remapped to
+    # DISTINCT out-of-bounds slots by _uset, so P itself may exceed the
+    # ring (tiny-ring tests pad well past capacity).
     mask = jnp.arange(P) < b.n_spans
     mask_a = jnp.arange(PA) < b.n_anns
     mask_b = jnp.arange(PB) < b.n_banns
 
     # -- span ring writes ----------------------------------------------
+    # Consecutive slots mod capacity are unique within a batch
+    # (P <= capacity, enforced by the host chunkers), so every ring
+    # column write rides the fast unique plane scatter (_uset).
     gids = state.write_pos + jnp.arange(P, dtype=jnp.int64)
     slots = (gids % c.capacity).astype(jnp.int32)
-    widx = jnp.where(mask, slots, c.capacity)  # OOB rows dropped
     upd = {}
     for col in (
         "trace_id", "span_id", "parent_id", "name_id", "name_lc_id",
         "service_id", "ts_cs", "ts_cr", "ts_sr", "ts_ss", "ts_first",
         "ts_last", "duration", "flags", "indexable",
     ):
-        upd[col] = getattr(state, col).at[widx].set(getattr(b, col), mode="drop")
-    upd["row_gid"] = state.row_gid.at[widx].set(gids, mode="drop")
+        upd[col] = _uset(getattr(state, col), slots, getattr(b, col),
+                         mask)
+    upd["row_gid"] = _uset(state.row_gid, slots, gids, mask)
     upd["write_pos"] = state.write_pos + b.n_spans.astype(jnp.int64)
 
     # -- annotation ring writes ----------------------------------------
     a_gids = state.ann_write_pos + jnp.arange(PA, dtype=jnp.int64)
     a_slots = (a_gids % c.ann_capacity).astype(jnp.int32)
-    a_widx = jnp.where(mask_a, a_slots, c.ann_capacity)
     span_gid_of_ann = state.write_pos + b.ann_span_idx.astype(jnp.int64)
-    upd["ann_gid"] = state.ann_gid.at[a_widx].set(
-        jnp.where(mask_a, span_gid_of_ann, -1), mode="drop"
+    upd["ann_gid"] = _uset(
+        state.ann_gid, a_slots, jnp.where(mask_a, span_gid_of_ann, -1),
+        mask_a,
     )
     for col in ("ann_ts", "ann_value_id", "ann_service_id", "ann_endpoint_id"):
-        upd[col] = getattr(state, col).at[a_widx].set(getattr(b, col), mode="drop")
+        upd[col] = _uset(getattr(state, col), a_slots, getattr(b, col),
+                         mask_a)
     upd["ann_write_pos"] = state.ann_write_pos + b.n_anns.astype(jnp.int64)
 
     bb_gids = state.bann_write_pos + jnp.arange(PB, dtype=jnp.int64)
     bb_slots = (bb_gids % c.bann_capacity).astype(jnp.int32)
-    bb_widx = jnp.where(mask_b, bb_slots, c.bann_capacity)
     span_gid_of_bann = state.write_pos + b.bann_span_idx.astype(jnp.int64)
-    upd["bann_gid"] = state.bann_gid.at[bb_widx].set(
-        jnp.where(mask_b, span_gid_of_bann, -1), mode="drop"
+    upd["bann_gid"] = _uset(
+        state.bann_gid, bb_slots,
+        jnp.where(mask_b, span_gid_of_bann, -1), mask_b,
     )
     for col in (
         "bann_key_id", "bann_value_id", "bann_type", "bann_service_id",
         "bann_endpoint_id",
     ):
-        upd[col] = getattr(state, col).at[bb_widx].set(getattr(b, col), mode="drop")
+        upd[col] = _uset(getattr(state, col), bb_slots, getattr(b, col),
+                         mask_b)
     upd["bann_write_pos"] = state.bann_write_pos + b.n_banns.astype(jnp.int64)
 
     # -- streaming dependency join -------------------------------------
@@ -1281,13 +1498,11 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     Qp = state.pend_key.shape[0]
     rank = jnp.cumsum(pending.astype(jnp.int64)) - 1
     pslot = ((state.pend_pos + rank) % Qp).astype(jnp.int32)
-    pidx = jnp.where(pending, pslot, Qp)
-    upd["pend_key"] = state.pend_key.at[pidx].set(
-        _tab_pack(ckey, b.service_id), mode="drop"
-    )
-    upd["pend_dur"] = state.pend_dur.at[pidx].set(b.duration, mode="drop")
-    upd["pend_tsf"] = state.pend_tsf.at[pidx].set(b.ts_first, mode="drop")
-    upd["pend_tsl"] = state.pend_tsl.at[pidx].set(b.ts_last, mode="drop")
+    upd["pend_key"] = _uset(state.pend_key, pslot,
+                            _tab_pack(ckey, b.service_id), pending)
+    upd["pend_dur"] = _uset(state.pend_dur, pslot, b.duration, pending)
+    upd["pend_tsf"] = _uset(state.pend_tsf, pslot, b.ts_first, pending)
+    upd["pend_tsl"] = _uset(state.pend_tsl, pslot, b.ts_last, pending)
     upd["pend_pos"] = state.pend_pos + pending.sum(dtype=jnp.int64)
 
     # -- index column families -----------------------------------------
@@ -1350,9 +1565,9 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         # annotation fast paths until the span is evicted (see
         # StoreState.ann_poison).
         mid = a_idx_ok & (a_host != h1) & (a_host != h2)
-        upd["ann_poison"] = state.ann_poison.at[
-            jnp.where(mid, a_host, S)
-        ].max(jnp.where(mid, span_gid_of_ann, I64_MIN), mode="drop")
+        upd["ann_poison"] = _war_max64(
+            state.ann_poison, a_host, span_gid_of_ann, mid
+        )
         v_ok = (
             mask_a & (b.ann_value_id >= FIRST_USER_ANNOTATION_ID)
             & (b.ann_value_id < jnp.int32(1 << 30))
@@ -1727,17 +1942,21 @@ def _iq_service_impl(entries, pos, wm, row_gid, indexable, trace_id,
 
 
 def _key_lookup_wm(key_tab, key_wm, mixed):
-    """Per-key cursor lookup (see StoreState.key_tab): (record found,
+    """Per-key record lookup (see StoreState.key_tab): (record found,
     max displaced gid) for the query key's verify word. Works on scalar
-    or [N]-vector ``mixed``."""
+    or [N]-vector ``mixed``. Fingerprint matches may alias a different
+    key's record — then the returned watermark is the shared (merged)
+    one, which can only be LARGER than the key's true watermark:
+    conservative for the completeness gate, and still sound for the
+    negative gate (an indexed key's probes always find its fp record,
+    or a drop was counted)."""
     T = key_tab.shape[0]
     k48 = mixed >> jnp.uint64(16)
+    fp = _fp31(k48)
     found = jnp.zeros(jnp.shape(k48), bool)
     wmv = jnp.full(jnp.shape(k48), I64_MIN, jnp.int64)
-    for slot in _tab_slots(k48, T):
-        cur = key_tab[slot].astype(jnp.uint64)
-        hit = (cur != jnp.uint64(_TAB_EMPTY)) & (
-            (cur >> jnp.uint64(16)) == k48)
+    for slot in _tab_slots(k48, T)[:_KEY_PROBES]:
+        hit = key_tab[slot] == fp
         wmv = jnp.where(hit & ~found, key_wm[slot], wmv)
         found |= hit
     return found, wmv
