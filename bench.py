@@ -151,7 +151,11 @@ def _tpu_config(capacity_log2: int, n_services: int, use_pallas: bool):
         use_pallas=use_pallas,
         idx_name_buckets=(1 << 16) if big else 0,
         idx_name_depth=256 if big else 0,
-        idx_key_slots=(1 << 22) if big else 0,
+        # ~4x the live key count: the i32-fingerprint claims (probes=3)
+        # fail ~load^3, so load 0.25 keeps ~98%+ of keys recorded and
+        # by-name queries on the fast path. i32 fps made slots half
+        # price (~34MB table + ~67MB watermarks at 2^23).
+        idx_key_slots=(1 << 23) if big else 0,
         # One dependency bucket closes per half ring (~2M spans): 64
         # time-tagged banks keep ~128M spans of windowed dependency
         # resolution before older windows fold into the all-time tail

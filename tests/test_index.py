@@ -142,20 +142,20 @@ def test_hot_trace_beyond_bucket_depth_falls_back():
     from zipkin_tpu.store.device import StoreConfig
 
     cfg = _cfg(True)
-    assert StoreConfig.TRACE_SPAN_DEPTH == 32
+    n_hot = StoreConfig.TRACE_SPAN_DEPTH + 18
     ep = Endpoint(5, 80, "hotsvc")
     hot = [
         Span(555, "op", i + 1, None,
              (Annotation(100 + i, "sr", ep), Annotation(200 + i, "ss", ep)),
              ())
-        for i in range(50)  # > TRACE_SPAN_DEPTH
+        for i in range(n_hot)  # > TRACE_SPAN_DEPTH
     ]
     fast, scan = TpuSpanStore(cfg), TpuSpanStore(_cfg(False))
     for st in (fast, scan):
         st.apply(hot)
     got = fast.get_spans_by_trace_ids([555])
     want = scan.get_spans_by_trace_ids([555])
-    assert got and len(got[0]) == 50
+    assert got and len(got[0]) == n_hot
     assert got == want
     assert fast.get_traces_duration([555]) == scan.get_traces_duration([555])
 

@@ -163,16 +163,23 @@ class StoreConfig(NamedTuple):
 
     # Trace-membership family: depths are fixed small constants (a
     # trace's rows per family), buckets scale so buckets*depth covers
-    # 2x the corresponding ring.
-    TRACE_SPAN_DEPTH = 32
-    TRACE_ANN_DEPTH = 64
-    TRACE_BANN_DEPTH = 32
+    # 4x the corresponding ring (see the clumping note below).
+    # Trace-membership rows cluster: one trace puts ALL its rows in one
+    # bucket, so per-lap bucket traffic is Poisson over ~2 traces — far
+    # lumpier than the per-row families. 2x-ring coverage left 13-30%
+    # of buckets wrapping faster than a ring lap (gates closed, measured
+    # round 4); 4x coverage via doubled depths buys the variance
+    # headroom while bucket count (and the write path's rank-sort
+    # geometry) stays put.
+    TRACE_SPAN_DEPTH = 64
+    TRACE_ANN_DEPTH = 128
+    TRACE_BANN_DEPTH = 64
 
     @property
     def trace_buckets(self) -> int:
         return _next_pow2_int(
             self.idx_trace_buckets
-            or max(256, 2 * self.capacity // self.TRACE_SPAN_DEPTH)
+            or max(256, 4 * self.capacity // self.TRACE_SPAN_DEPTH)
         )
 
     # -- unified index layouts -------------------------------------------
@@ -307,7 +314,11 @@ def _uset_cols64(arr, idx, vals, ok):
 # checkpoint rev<9 migration).
 _FP_EMPTY = jnp.int32(0x7FFFFFFF)
 _FP_TOMB = jnp.int32(-0x80000000)
-_KEY_PROBES = 2
+# Claim failure scales ~load^PROBES (slots only fill, so a key that
+# fails all probes fails forever and its queries lose the per-key fast
+# path). 3 probes at the bench's 0.25 load keeps misses under ~2% for
+# one extra i32 gather+war round per ingest step.
+_KEY_PROBES = 3
 
 
 def _fp31(k48):
@@ -379,28 +390,45 @@ _LO_FLIP = jnp.int32(-0x80000000)  # sign-flip: u32 order as i32 order
 
 
 def _war_max64(arr, idx, vals, ok):
-    """``arr.at[idx[ok]].max(vals[ok])`` for an i64 WATERMARK array via
-    two independent i32 plane max-wars (duplicate indices allowed — i32
-    scatter-max vectorizes at ~9 ns/row on this backend; i64 serializes
-    at ~100 ns/row).
+    """``arr.at[idx[ok]].max(vals[ok])`` for an i64 WATERMARK array —
+    EXACT — via a two-phase i32 plane war (duplicate indices allowed;
+    i32 scatter-max vectorizes at ~9 ns/row on this backend while i64
+    serializes at ~100 ns/row):
 
-    CONSERVATIVE, not exact: the result is elementwise
-    (max of hi planes, max of lo planes), which equals the true i64 max
-    unless two contenders straddle a 2^32 boundary in the same war —
-    then the stored value can only be LARGER than the true max. Every
-    caller is a watermark where overstatement means extra exactness
-    fallbacks, never a wrong answer (and understatement is impossible:
-    both wars only raise). The lo plane is sign-flipped so unsigned
-    32-bit order matches i32 compare; I64_MIN's planes are INT32_MIN
-    twice under the flip, losing every war — the empty sentinel."""
+    1. hi planes war (signed i32 compare == i64 order on high words);
+    2. one i32 gather reads each row's SETTLED hi;
+    3. lo planes war, entered only by rows whose hi equals the settled
+       hi, against a base that keeps the slot's old lo only where its
+       hi survived (both conditions computable elementwise).
+
+    The lo plane is sign-flipped so unsigned 32-bit order matches i32
+    compare; I64_MIN's planes are (INT32_MIN, INT32_MIN) under the
+    flip, losing every war — the empty sentinel round-trips bit-exact.
+    Earlier conservative variants (independent plane maxes) overstated
+    by up to a plane boundary and systematically closed the bucket
+    gates in the round-4 bench — a watermark's VALUE is the product."""
+    neg = jnp.int32(-0x80000000)
     p = _p32(arr)
+    lo_arr = p[:, 0] ^ _LO_FLIP
+    hi_arr = p[:, 1]
     v = _p32(jnp.asarray(vals, jnp.int64))
     safe = jnp.where(ok, idx.astype(jnp.int32), arr.shape[0])
-    lo_off = jnp.where(ok, v[:, 0] ^ _LO_FLIP, _LO_FLIP)
-    hi_off = jnp.where(ok, v[:, 1], _LO_FLIP)
-    lo = (p[:, 0] ^ _LO_FLIP).at[safe].max(lo_off, mode="drop") ^ _LO_FLIP
-    hi = p[:, 1].at[safe].max(hi_off, mode="drop")
-    return _p64(jnp.stack([lo, hi], axis=-1))
+    hi_off = jnp.where(ok, v[:, 1], neg)
+    hi_after = hi_arr.at[safe].max(hi_off, mode="drop")
+    settled = hi_after[jnp.where(ok, idx.astype(jnp.int32), 0)]
+    lo_base = jnp.where(hi_after == hi_arr, lo_arr, neg)
+    lo_off = jnp.where(ok & (v[:, 1] == settled),
+                       v[:, 0] ^ _LO_FLIP, neg)
+    lo_after = lo_base.at[safe].max(lo_off, mode="drop")
+    return _p64(jnp.stack([lo_after ^ _LO_FLIP, hi_after], axis=-1))
+
+
+def _war_min64(arr, idx, vals, ok):
+    """Exact ``arr.at[idx[ok]].min(vals[ok])`` — bitwise NOT reverses
+    i64 order without overflow, so a min-war is a max-war in the
+    complemented domain (an I64_MAX empty sentinel complements to
+    _war_max64's I64_MIN one)."""
+    return ~_war_max64(~arr, idx, ~jnp.asarray(vals, jnp.int64), ok)
 
 
 def _ring(n, dtype, fill=0):
@@ -1019,6 +1047,7 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     # old occupancy gather (old gid >= 0) exactly.
     occupied = keep & (pos_b + rank >= depth)
     gidx = jnp.where(keep, slot, 0)
+    old_gid = entries[:, 0][gidx]
     old_verify = entries[:, 1][gidx]
     old_ts = jnp.where(occupied, entries[:, 2][gidx], I64_MIN)
     dropped_ts = jnp.where(valid & ~keep, jnp.asarray(ts, jnp.int64),
@@ -1055,17 +1084,17 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
         )
         after = key_tab[kslot]
         placed |= attempt & (after == fp)
-    # 2. Record displacements: bucket-wrap victims are attributed to the
-    #    displaced entry's key (old verify); in-batch overflow drops to
-    #    their own key. The recorded gid is the CURRENT row's gid — an
-    #    upper bound on the displaced entry's gid (it is always older),
-    #    so the eviction gate fires at most one ring lap later than the
-    #    exact value would allow: conservative, and it saves the old-gid
-    #    gather (i64 gathers cost ~25 ns/row here).
+    # 2. Record displacements: bucket-wrap victims carry their OLD
+    #    entry's (verify, gid); in-batch overflow drops carry their own.
+    #    The displaced gid must be the TRUE old gid (not the current
+    #    row's): a busy key's displaced entries are ~2 window-laps old
+    #    and already evicted, which is exactly what keeps its record's
+    #    eviction gate passing in steady state.
     disp_ok = jnp.asarray(keyed, bool) & (
         (keep & occupied) | (valid & ~keep)
     )
     disp_key = jnp.where(keep, old_verify, verify)
+    disp_gid = jnp.where(keep, old_gid, gid)
     k48d = disp_key.astype(jnp.uint64) >> jnp.uint64(16)
     fpd = _fp31(k48d)
     dslot = jnp.full(k48d.shape, T, jnp.int32)
@@ -1075,7 +1104,7 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
         hit = ~dfound & (cur == fpd)
         dslot = jnp.where(hit, kslot, dslot)
         dfound |= hit
-    key_wm = _war_max64(key_wm, dslot, gid, disp_ok & dfound)
+    key_wm = _war_max64(key_wm, dslot, disp_gid, disp_ok & dfound)
     n_drops = (ins_ok & ~placed).sum().astype(jnp.int64)
     return entries, pos, wm, key_tab, key_wm, n_drops
 
@@ -1086,10 +1115,13 @@ def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
     oldest-first, so once wm < (ring write_pos - ring capacity),
     everything a bucket lost is already evicted and the bucket provably
     holds every RESIDENT row of its traces — the query-time exactness
-    gate. Sizing buckets*depth >= 2x the ring keeps the gate true in
-    steady state; only a single trace hotter than ``depth`` rows per
-    family keeps its own gate false forever, which the scan fallback
-    covers."""
+    gate. Sizing buckets*depth >= 4x the ring keeps the gate true in
+    steady state even under trace clumping (a trace's rows all land in
+    ONE bucket, so per-lap bucket traffic is Poisson over a couple of
+    traces — at 2x coverage that variance measurably wrapped 13-30% of
+    buckets faster than a ring lap); only a trace hotter than ``depth``
+    rows per family keeps its own gate false forever, which the scan
+    fallback covers."""
     n_b = pos.shape[0]
     rank = _fifo_ranks(gbucket, valid, n_b)
     b_c = jnp.clip(gbucket, 0, n_b - 1)
@@ -1097,17 +1129,21 @@ def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
     cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
         1, mode="drop")[:n_b]
     keep = valid & (rank >= cnt[b_c] - depth)
-    # i32 low-plane cursor math + gather-free displacement test, exactly
-    # as in _index_write. The recorded watermark gid is the CURRENT
-    # row's gid — an upper bound on the displaced entry's (older) gid,
-    # so the exactness gate fires at most one ring lap late
-    # (conservative; saves the i64 old-entry gather).
+    # i32 low-plane cursor math + cursor-derived displacement test,
+    # exactly as in _index_write. The watermark needs the TRUE displaced
+    # gid (one i64 gather): under continuous displacement the displaced
+    # entry is ~2 window-laps old and already ring-evicted, so the
+    # exactness gate keeps passing in steady state — substituting the
+    # current row's (always-recent) gid would hold every busy bucket's
+    # gate closed forever.
     pos_lo = _p32(pos)[:, 0]
     pos_b = pos_lo[b_c]
     slot = slot0.astype(jnp.int32) + ((pos_b + rank) % depth)
     occupied = keep & (pos_b + rank >= depth)
     gid = jnp.asarray(gid, jnp.int64)
-    wm = _war_max64(wm, oob_b, gid, occupied | (valid & ~keep))
+    old_gid = entries[jnp.where(keep, slot, 0)]
+    wmv = jnp.where(occupied, old_gid, gid)
+    wm = _war_max64(wm, oob_b, wmv, occupied | (valid & ~keep))
     entries = _uset(entries, slot, gid, keep)
     pos = pos + cnt.astype(pos.dtype)
     return entries, pos, wm
@@ -1389,6 +1425,44 @@ def _dep_in_range_impl(dep_moments, dep_banks, dep_bank_ts,
     total = M.combine(total, jnp.where(ov, dep_moments, 0.0))
     w_ok = (dep_window_ts[0] <= end_ts) & (dep_window_ts[1] >= start_ts)
     return M.combine(total, jnp.where(w_ok, dep_window, 0.0))
+
+
+def _compact_bank(bank, k: int):
+    """(n_nonzero, row ids [k], rows [k, 5]) — top-k-by-count compaction
+    of a [S*S, 5] Moments bank. Real deployments have O(S) live links,
+    so shipping the k densest rows instead of the whole bank cuts the
+    host transfer from ~20 MB to ~400 KB (the tunnel D2H was the entire
+    dependencies-query p99). The caller must verify n_nonzero <= k and
+    fall back to the full bank otherwise — compaction never silently
+    drops a link."""
+    counts = bank[:, 0]
+    nz = (counts > 0).sum(dtype=jnp.int32)
+    _, idx = jax.lax.top_k(counts, k)
+    return nz, idx.astype(jnp.int32), bank[idx]
+
+
+@partial(jax.jit, static_argnums=(3,))
+def total_dep_moments_compact(dep_moments, dep_banks, dep_window,
+                              k: int):
+    """total_dep_moments fused with _compact_bank in one launch."""
+    return _compact_bank(
+        _total_dep_impl.__wrapped__(dep_moments, dep_banks, dep_window),
+        k,
+    )
+
+
+@partial(jax.jit, static_argnums=(8,))
+def dep_in_range_compact(dep_moments, dep_banks, dep_bank_ts,
+                         dep_overflow_ts, dep_window, dep_window_ts,
+                         start_ts, end_ts, k: int):
+    """dep_moments_in_range fused with _compact_bank in one launch."""
+    return _compact_bank(
+        _dep_in_range_impl.__wrapped__(
+            dep_moments, dep_banks, dep_bank_ts, dep_overflow_ts,
+            dep_window, dep_window_ts, start_ts, end_ts,
+        ),
+        k,
+    )
 
 
 def dep_moments_in_range(state: "StoreState", start_ts, end_ts):
@@ -1843,10 +1917,11 @@ def _q_by_annotation_impl(
     a_slot, a_live = _span_slot(ann_gid, row_gid, capacity)
     # Build: which span slots have an annotation hosted by svc_id.
     hit = a_live & (ann_service_id == svc_id)
-    per_slot = jnp.zeros(capacity + 1, bool)
-    per_slot = per_slot.at[jnp.where(hit, a_slot, capacity)].set(
-        True, mode="drop"
-    )[:-1]
+    # i32 max instead of a bool scatter-set: bool scatters serialize on
+    # this backend (ann-ring-sized rows), i32 dup-index max vectorizes.
+    per_slot = jnp.zeros(capacity + 1, jnp.int32).at[
+        jnp.where(hit, a_slot, capacity)
+    ].max(hit.astype(jnp.int32), mode="drop")[:-1] > 0
 
     a_ok = (
         a_live
@@ -2380,20 +2455,20 @@ def _q_durations_impl(trace_id, row_gid, ts_first, ts_last, sorted_qids):
     match = live & (sorted_qids[pos_c] == trace_id)
     seg = jnp.where(match, pos_c, nq)
     has_ts = match & (ts_first >= 0)
-    firsts = jnp.where(has_ts, ts_first, I64_MAX)
-    lasts = jnp.where(has_ts, ts_last, I64_MIN)
-    min_first = (
-        jnp.full(nq + 1, I64_MAX, jnp.int64).at[seg].min(firsts, mode="drop")[:nq]
-    )
-    max_last = (
-        jnp.full(nq + 1, I64_MIN, jnp.int64).at[seg].max(lasts, mode="drop")[:nq]
-    )
-    found = (
-        jnp.zeros(nq + 1, bool).at[seg].max(has_ts, mode="drop")[:nq]
-    )
-    present = (
-        jnp.zeros(nq + 1, bool).at[seg].max(match, mode="drop")[:nq]
-    )
+    # Ring-sized i64/bool scatter-reductions serialize on this backend
+    # (~100 ns/row — 4.2M rows cost ~420 ms EACH; this kernel was the
+    # whole q_durations p99); the exact plane wars and i32 maxes
+    # vectorize.
+    min_first = _war_min64(
+        jnp.full(nq + 1, I64_MAX, jnp.int64), seg, ts_first, has_ts
+    )[:nq]
+    max_last = _war_max64(
+        jnp.full(nq + 1, I64_MIN, jnp.int64), seg, ts_last, has_ts
+    )[:nq]
+    found = jnp.zeros(nq + 1, jnp.int32).at[seg].max(
+        has_ts.astype(jnp.int32), mode="drop")[:nq] > 0
+    present = jnp.zeros(nq + 1, jnp.int32).at[seg].max(
+        match.astype(jnp.int32), mode="drop")[:nq] > 0
     return jnp.stack([
         present.astype(jnp.int64), found.astype(jnp.int64), min_first, max_last
     ])
