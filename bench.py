@@ -604,7 +604,8 @@ def bench_checkpoint(store):
     # Fixed path, pre-cleaned: an abandoned (watchdog-timed-out) run
     # never executes this function's finally-rmtree, so the next run
     # must be able to reclaim the leaked partial snapshot.
-    path = os.path.join(tempfile.gettempdir(), "zk_bench_ckpt")
+    path = os.path.join(tempfile.gettempdir(),
+                        f"zk_bench_ckpt_{os.getuid()}")
     shutil.rmtree(path, ignore_errors=True)
     os.makedirs(path, exist_ok=True)
     try:

@@ -638,3 +638,40 @@ def test_pre_rev8_snapshot_disables_negative_gate(tmp_path):
     assert int(np.asarray(
         again.state.counters["key_claim_drops"]
     )) == int(np.asarray(store.state.counters["key_claim_drops"]))
+
+
+def test_dictionary_overflow_service_routes_to_scan():
+    """More distinct services than max_services: overflow services live
+    only in the raw ring columns (no index family can represent them),
+    so their queries must take the scan path and still answer exactly —
+    never the index's trusted-empty (round-4 parity-drive finding)."""
+    spans = [s for t in generate_traces(n_traces=30, max_depth=3,
+                                        n_services=12) for s in t]
+    fast = TpuSpanStore(_cfg(True, max_services=4))
+    scan = TpuSpanStore(_cfg(False, max_services=4))
+    mem_names = set()
+    for st in (fast, scan):
+        st.apply(spans)
+    end_ts = max(s.last_timestamp for s in spans if s.last_timestamp) + 1
+    # Query EVERY service that appears in the raw spans, including ones
+    # whose dictionary id exceeds max_services.
+    for s in spans:
+        for a in s.annotations:
+            if a.host and a.host.service_name:
+                mem_names.add(a.host.service_name)
+    assert len(mem_names) > 4  # the overflow case is actually exercised
+    for svc in sorted(mem_names):
+        got = _ids(fast.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, end_ts, 10))
+        want = _ids(scan.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, end_ts, 10))
+        assert got == want, svc
+        got_n = _ids(fast.get_trace_ids_by_name(svc, None, end_ts, 10))
+        want_n = _ids(scan.get_trace_ids_by_name(svc, None, end_ts, 10))
+        assert got_n == want_n, svc
+    # The batched multi path must agree as well.
+    queries = [("name", svc, None, end_ts, 10) for svc in sorted(mem_names)]
+    multi = fast.get_trace_ids_multi(queries)
+    for svc, res in zip(sorted(mem_names), multi):
+        assert _ids(res) == _ids(
+            scan.get_trace_ids_by_name(svc, None, end_ts, 10)), svc

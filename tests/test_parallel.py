@@ -230,3 +230,43 @@ def test_multihost_routing_math(mesh):
         store.apply(groups[0])
         tid = groups[0][0].trace_id
         assert store.get_spans_by_trace_ids([tid])
+
+
+def test_sharded_dictionary_overflow_service_routes_to_scan(mesh):
+    """Overflow services (dictionary id >= max_services) must scan on
+    the sharded store too — the index path would trusted-empty them
+    (round-4 review finding: the fix originally landed single-device
+    only, while get_trace_ids_multi's fallback funnels overflow queries
+    into exactly these sharded singular paths)."""
+    from zipkin_tpu.store.device import StoreConfig
+    from zipkin_tpu.tracegen import generate_traces
+
+    cfg = StoreConfig(capacity=1 << 10, ann_capacity=1 << 12,
+                      bann_capacity=1 << 11, max_services=4,
+                      use_index=True)
+    scan_cfg = cfg._replace(use_index=False)
+    sharded = ShardedSpanStore(mesh, cfg)
+    oracle = ShardedSpanStore(mesh, scan_cfg)
+    spans = [s for t in generate_traces(n_traces=24, max_depth=3,
+                                        n_services=12) for s in t]
+    names = set()
+    for s in spans:
+        for a in s.annotations:
+            if a.host and a.host.service_name:
+                names.add(a.host.service_name)
+    assert len(names) > 4
+    for st in (sharded, oracle):
+        st.apply(spans)
+    end_ts = max(s.last_timestamp for s in spans if s.last_timestamp) + 1
+
+    def ids(res):
+        return sorted((i.trace_id, i.timestamp) for i in res)
+
+    for svc in sorted(names):
+        assert ids(sharded.get_trace_ids_by_name(svc, None, end_ts, 10)) \
+            == ids(oracle.get_trace_ids_by_name(svc, None, end_ts, 10)), svc
+        assert ids(sharded.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, end_ts, 10
+        )) == ids(oracle.get_trace_ids_by_annotation(
+            svc, "some custom annotation", None, end_ts, 10
+        )), svc

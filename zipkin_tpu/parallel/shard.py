@@ -693,9 +693,11 @@ class ShardedSpanStore:
             window = len(cands) if truncated else len(cands) + 1
             return cands, bool(np.all(complete)), int(np.max(wm)), window
 
-        from zipkin_tpu.store.base import index_first_topk
+        from zipkin_tpu.store.base import (index_first_topk,
+                                           service_scan_only)
 
-        if self.config.use_index:
+        if self.config.use_index and not service_scan_only(
+                svc, self.config):
             return index_first_topk(
                 limit, self.config.ann_capacity, index_fetch, fetch
             )
@@ -754,10 +756,11 @@ class ShardedSpanStore:
             window = len(cands) if truncated else len(cands) + 1
             return cands, bool(np.all(complete)), int(np.max(wm)), window
 
-        from zipkin_tpu.store.base import index_first_topk
+        from zipkin_tpu.store.base import (index_first_topk,
+                                           service_scan_only)
 
         c = self.config
-        if c.use_index and not mixed:
+        if c.use_index and not mixed and not service_scan_only(svc, c):
             return index_first_topk(
                 limit, c.ann_capacity + c.bann_capacity, index_fetch,
                 fetch,

@@ -177,6 +177,16 @@ def fill_pin(pins: PinBank, lock, tid: int, fetch_spans) -> None:
         pins.pin(tid, list(banked) + [s for s in found if s not in seen])
 
 
+def service_scan_only(svc_id: int, config) -> bool:
+    """True when a resolved service id overflows the store's service
+    capacity (dictionary id >= max_services): such services exist only
+    in the raw ring columns — no index family, histogram, or key record
+    can represent them — so the index fast path would return a trusted
+    EMPTY while the scan finds their spans. Every device-store query
+    path must route these to the scan (slower, never wrong)."""
+    return svc_id >= config.max_services
+
+
 def resolve_annotation_query(dicts, annotation: str, value):
     """Dictionary-id resolution for get_trace_ids_by_annotation, shared
     by the single-device and sharded stores. Returns

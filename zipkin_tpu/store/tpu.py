@@ -49,6 +49,7 @@ from zipkin_tpu.store.base import (
     index_topk_or_none,
     prune_ttls,
     resolve_annotation_query,
+    service_scan_only,
     should_index,
     topk_ids_with_escalation,
 )
@@ -83,6 +84,9 @@ def resolve_multi_probes(config, dicts, queries):
             if svc is None or limit <= 0:
                 results[qi] = []
                 continue
+            if service_scan_only(svc, config):
+                fallback.append(qi)  # overflow service: scan-only
+                continue
             if span_name is not None:
                 name_lc = dicts.span_names.get(span_name.lower())
                 if name_lc is None:
@@ -103,6 +107,9 @@ def resolve_multi_probes(config, dicts, queries):
             svc = dicts.services.get(service.lower())
             if svc is None:
                 results[qi] = []
+                continue
+            if service_scan_only(svc, config):
+                fallback.append(qi)  # overflow service: scan-only
                 continue
             resolved = resolve_annotation_query(dicts, annotation, value)
             if resolved is None:
@@ -715,6 +722,7 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service_name)
         if svc is None or limit <= 0:
             return []
+        force_scan = force_scan or service_scan_only(svc, self.config)
         if span_name is not None:
             name_lc = self.dicts.span_names.get(span_name.lower())
             if name_lc is None:
@@ -764,6 +772,7 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service_name)
         if svc is None:
             return []
+        force_scan = force_scan or service_scan_only(svc, self.config)
         resolved = resolve_annotation_query(self.dicts, annotation, value)
         if resolved is None:
             return []
