@@ -675,3 +675,27 @@ def test_dictionary_overflow_service_routes_to_scan():
     for svc, res in zip(sorted(mem_names), multi):
         assert _ids(res) == _ids(
             scan.get_trace_ids_by_name(svc, None, end_ts, 10)), svc
+    # Catalog endpoints must not clamp an overflow id into the last
+    # indexed row (advisor r4: a clamped gather silently serves service
+    # max_services-1's data): compare every endpoint against a store
+    # whose service capacity covers the whole vocabulary. Counts match
+    # exactly because the ring never wraps in this test (the scan path
+    # counts ring-resident rows; the indexed path counts lifetime).
+    big = TpuSpanStore(_cfg(True))  # max_services=32 covers all
+    big.apply(spans)
+
+    def canon(pairs):  # top-k tie ORDER is not a product guarantee
+        return sorted(pairs, key=lambda p: (-p[1], p[0]))
+
+    for svc in sorted(mem_names):
+        assert fast.get_span_names(svc) == big.get_span_names(svc), svc
+        # k past the vocabulary so tie-breaks at the cutoff can't
+        # change set membership.
+        assert canon(fast.top_annotations(svc, 999)) == \
+            canon(big.top_annotations(svc, 999)), svc
+        assert canon(fast.top_binary_keys(svc, 999)) == \
+            canon(big.top_binary_keys(svc, 999)), svc
+        qs = [0.5, 0.95]
+        assert fast.service_duration_quantiles(svc, qs) == \
+            big.service_duration_quantiles(svc, qs), svc
+    assert fast.get_all_service_names() == big.get_all_service_names()

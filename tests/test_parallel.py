@@ -270,3 +270,21 @@ def test_sharded_dictionary_overflow_service_routes_to_scan(mesh):
         )) == ids(oracle.get_trace_ids_by_annotation(
             svc, "some custom annotation", None, end_ts, 10
         )), svc
+    # Catalog endpoints (span names, quantiles, top-k) must not clamp
+    # overflow ids into the last indexed row (advisor r4) — compare
+    # against a sharded store whose capacity covers the vocabulary.
+    big = ShardedSpanStore(mesh, cfg._replace(max_services=32))
+    big.apply(spans)
+
+    def canon(pairs):  # top-k tie ORDER is not a product guarantee
+        return sorted(pairs, key=lambda p: (-p[1], p[0]))
+
+    for svc in sorted(names):
+        assert sharded.get_span_names(svc) == big.get_span_names(svc), svc
+        assert canon(sharded.top_annotations(svc, 999)) == \
+            canon(big.top_annotations(svc, 999)), svc
+        assert canon(sharded.top_binary_keys(svc, 999)) == \
+            canon(big.top_binary_keys(svc, 999)), svc
+        assert sharded.service_duration_quantiles(svc, [0.5, 0.99]) == \
+            big.service_duration_quantiles(svc, [0.5, 0.99]), svc
+    assert sharded.get_all_service_names() == big.get_all_service_names()

@@ -279,8 +279,14 @@ def load(path: str, mesh=None):
         # doubling): a pre-10 tr_idx/tr_pos/tr_wm would misalign against
         # the new slot math while its cursors still claimed exactness.
         # Drop the stale arrays and poison the family's trust (cursor
-        # past depth, watermark +inf) so the scan serves restored spans
-        # — the same treatment pre-unification layouts get.
+        # past depth) so the scan serves restored spans — the same
+        # treatment pre-unification layouts get. The watermark seed is
+        # the restore-time write_pos, NOT +inf: wm = wp claims "any
+        # restored-era gid may have been displaced", which the trust
+        # gate (wm < write_pos - capacity) re-opens after one full ring
+        # lap, once every restored span is evicted and the fresh tr_idx
+        # is authoritative — ann_poison's self-healing pattern. A
+        # permanent I64_MAX would scan trace queries forever.
         for k in ("tr_idx", "tr_pos", "tr_wm"):
             upd.pop(k, None)
         shape = (config.trace_layout[1],)
@@ -288,8 +294,16 @@ def load(path: str, mesh=None):
             shape = (n_shards,) + shape  # stacked sharded state
         big = jax.numpy.int64(1) << 60
         upd["tr_pos"] = jax.numpy.full(shape, big, jax.numpy.int64)
-        upd["tr_wm"] = jax.numpy.full(shape, dev.I64_MAX,
-                                      jax.numpy.int64)
+        wp = upd.get("write_pos")
+        if wp is None:
+            wm_seed = jax.numpy.full(shape, dev.I64_MAX,
+                                     jax.numpy.int64)
+        else:
+            wp = jax.numpy.asarray(wp, jax.numpy.int64)
+            if n_shards:
+                wp = wp.reshape((-1, 1))  # [n_shards] -> broadcastable
+            wm_seed = jax.numpy.broadcast_to(wp, shape)
+        upd["tr_wm"] = wm_seed
     if revision < 9 and "key_tab" in upd:
         # Revisions < 9 stored exact 64-bit key words; the table is now
         # 31-bit fingerprints (i32). The packed words are recoverable

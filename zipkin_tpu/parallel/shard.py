@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from zipkin_tpu.ops import moments as M
 from zipkin_tpu.store import device as dev
+from zipkin_tpu.store.base import service_scan_only
 
 
 def _stack_states(config: dev.StoreConfig, n: int):
@@ -961,16 +962,84 @@ class ShardedSpanStore:
     def get_all_service_names(self):
         present = self._cat("ann_svc_counts") > 0
         d = self.dicts.services
-        return {
+        out = {
             d.decode(i) for i in np.flatnonzero(present)
             if i < len(d) and d.decode(i)
         }
+        # Dictionary-overflow services can't mark the presence array —
+        # list the ones any shard's rings still hold as hosts (see
+        # TpuSpanStore.get_all_service_names; OR across shards rides
+        # a psum of the per-shard presence).
+        S = self.config.max_services
+        n_over = len(d) - S
+        if n_over > 0:
+            pad = 1 << max(0, (n_over - 1)).bit_length()
+
+            def build():
+                def fn(state):
+                    st = self._unstack(state)
+                    pres = dev.overflow_service_presence(st, pad)
+                    return jax.lax.psum(
+                        pres.astype(jnp.int32), self.axis) > 0
+
+                return jax.jit(jax.shard_map(
+                    fn, mesh=self.mesh, in_specs=(P(self.axis),),
+                    out_specs=P(), check_vma=False,
+                ))
+
+            with self._rw.read():
+                pres = jax.device_get(
+                    self._kernel(("overflow_presence", pad), build)(
+                        self.states)
+                )
+            out.update(
+                name for i in np.flatnonzero(pres[:n_over])
+                if (name := d.decode(S + int(i)))
+            )
+        return out
+
+    def _scan_cat_kernel(self):
+        """Overflow-service catalog reads: per-shard ring scans
+        (dev.svc_scan_catalog) psum-ed across the mesh — the
+        [max_services]-sized catalog arrays cannot represent services
+        past the dictionary cap, and a clamped row read would serve
+        service max_services-1's data under the wrong name."""
+        def build():
+            def fn(state, svc):
+                st = self._unstack(state)
+                rows = dev.svc_scan_catalog(st, svc)
+                return tuple(jax.lax.psum(r, self.axis) for r in rows)
+
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
+                out_specs=(P(),) * 4, check_vma=False,
+            ))
+
+        return self._kernel(("scan_catalog",), build)
+
+    def _svc_catalog_scan(self, svc: int):
+        # One-entry memo keyed on (svc, write position): the kernel
+        # returns all four catalog rows per launch — see
+        # TpuSpanStore._svc_catalog_scan.
+        key = (svc, self.inner._wp_upper)
+        cached = getattr(self, "_svc_scan_memo", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        with self._rw.read():
+            rows = jax.device_get(
+                self._scan_cat_kernel()(self.states, jnp.int32(svc))
+            )
+        self._svc_scan_memo = (key, rows)
+        return rows
 
     def get_span_names(self, service: str):
         svc = self._svc_id(service)
         if svc is None:
             return set()
-        row = self._cat("name_presence", svc) > 0
+        if service_scan_only(svc, self.config):
+            row = self._svc_catalog_scan(svc)[0] > 0
+        else:
+            row = self._cat("name_presence", svc) > 0
         d = self.dicts.span_names
         return {
             d.decode(i) for i in np.flatnonzero(row)
@@ -1050,14 +1119,20 @@ class ShardedSpanStore:
             return None
         c = self.config
         gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
-        counts = self._cat("svc_hist", svc)
+        if service_scan_only(svc, c):
+            counts = self._svc_catalog_scan(svc)[1]
+        else:
+            counts = self._cat("svc_hist", svc)
         return Q.quantiles_host(counts, gamma, 1.0, qs)
 
     def top_annotations(self, service: str, k: int = 10):
         svc = self._svc_id(service)
         if svc is None:
             return []
-        row = self._cat("ann_value_counts", svc)
+        if service_scan_only(svc, self.config):
+            row = self._svc_catalog_scan(svc)[2]
+        else:
+            row = self._cat("ann_value_counts", svc)
         order = np.argsort(-row)[:k]
         d = self.dicts.annotations
         return [
@@ -1069,7 +1144,10 @@ class ShardedSpanStore:
         svc = self._svc_id(service)
         if svc is None:
             return []
-        row = self._cat("bann_key_counts", svc)
+        if service_scan_only(svc, self.config):
+            row = self._svc_catalog_scan(svc)[3]
+        else:
+            row = self._cat("bann_key_counts", svc)
         order = np.argsort(-row)[:k]
         d = self.dicts.binary_keys
         return [

@@ -323,8 +323,16 @@ _KEY_PROBES = 3
 
 def _fp31(k48):
     """48-bit key -> 31-bit non-negative fingerprint (never _FP_EMPTY).
-    Uses the key's top bits — _tab_slots consumes the low bits for the
-    probe sequence, so slot and fingerprint stay independent."""
+    Takes bits 17..47; _tab_slots consumes the low log2(T) bits for the
+    probe sequence, so at T = 2^23 slots the two overlap by ~6 bits and
+    same-slot keys already agree on that much of the fingerprint: the
+    same-slot collision odds are ~2^-(31 - max(0, log2(T) - 17)), about
+    2^-25 at bench geometry — NOT the full 2^-31. A collision only
+    makes two keys share a record and merge watermarks (conservative:
+    extra scan fallbacks, never a wrong answer), so the margin is spent
+    on fallback rate, not correctness. (Kept as a plain shift rather
+    than a mixed hash: the fingerprints live in checkpoints, and
+    changing the function would tombstone every restored key table.)"""
     f = (k48 >> jnp.uint64(17)).astype(jnp.int32) & jnp.int32(0x7FFFFFFF)
     return jnp.minimum(f, jnp.int32(0x7FFFFFFE))
 
@@ -650,6 +658,16 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         pend_tsf=jnp.zeros(c.pending_slots, jnp.int64),
         pend_tsl=jnp.zeros(c.pending_slots, jnp.int64),
         pend_pos=jnp.int64(0),
+        # LOAD-BEARING init values: _index_write/_gid_index_write derive
+        # slot occupancy from cursors (pos + rank >= depth), which
+        # over-claims when an in-batch bucket overflow (cnt > depth)
+        # skipped slots this cursor lap — such "occupied" slots still
+        # hold these INIT entries, and the displacement path feeds them
+        # into the watermark wars and the fp-key lookup. That is
+        # harmless precisely because gid/ts = -1 / I64_MIN lose every
+        # max-war and verify = -1 hashes to a fingerprint that matches
+        # no claimed key. Changing these fills requires re-deriving that
+        # argument (or adding an explicit old-entry validity check).
         cand_idx=jnp.full((c.cand_layout[2], 3), -1, jnp.int64),
         cand_pos=jnp.zeros(c.cand_layout[1], jnp.int64),
         cand_wm=jnp.full(c.cand_layout[1], I64_MIN, jnp.int64),
@@ -702,6 +720,106 @@ def svc_histogram(state: StoreState) -> Q.LogHistogram:
     c = state.config
     gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
     return Q.LogHistogram(state.svc_hist, gamma, 1.0)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _svc_scan_catalog_impl(dims, service_id_col, duration, row_gid,
+                           ann_gid, ann_service_id, ann_value_id,
+                           name_id_col, name_lc_col, indexable,
+                           bann_gid, bann_service_id, bann_key_id, svc):
+    cap, n_names, n_q, n_av, n_bk, gamma = dims
+
+    def hadd(n, idx, ok):
+        # -1-masked rows must go through the scratch-slot remap
+        # (_scatter_add): a raw ``.at[-1].add`` WRAPS to the last
+        # bucket (NumPy negative indexing), silently inflating it.
+        ones = jnp.ones(idx.shape, jnp.int32)
+        return _scatter_add(jnp.zeros(n, jnp.int32),
+                            jnp.where(ok, idx, -1), ones, False)
+
+    # Span-ring rows of this service: duration log-histogram.
+    m_sp = (row_gid >= 0) & (service_id_col == svc) & (duration >= 0)
+    hist = Q.LogHistogram(jnp.zeros(n_q, jnp.int32), gamma, 1.0)
+    bidx = Q.bucket_index(hist, duration.astype(jnp.float32))
+    dur_row = hadd(n_q, bidx, m_sp)
+    # Annotation-ring rows hosted by this service.
+    m_a = (ann_gid >= 0) & (ann_service_id == svc)
+    slot, live = _span_slot(ann_gid, row_gid, cap)
+    nm = name_id_col[slot]
+    nm_ok = (
+        m_a & live & indexable[slot] & (name_lc_col[slot] >= 0)
+        & (nm >= 0) & (nm < n_names)
+    )
+    name_row = hadd(n_names, nm, nm_ok)
+    av_ok = (
+        m_a & (ann_value_id >= FIRST_USER_ANNOTATION_ID)
+        & (ann_value_id < n_av)
+    )
+    ann_row = hadd(n_av, ann_value_id, av_ok)
+    # Binary-annotation-ring rows hosted by this service.
+    bk_ok = (
+        (bann_gid >= 0) & (bann_service_id == svc)
+        & (bann_key_id >= 0) & (bann_key_id < n_bk)
+    )
+    bkey_row = hadd(n_bk, bann_key_id, bk_ok)
+    return name_row, dur_row, ann_row, bkey_row
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _overflow_presence_impl(base, n_over, ann_gid, ann_service_id,
+                            bann_gid, bann_service_id):
+    pres = jnp.zeros(n_over, jnp.int32)
+    for gid, svc in ((ann_gid, ann_service_id),
+                     (bann_gid, bann_service_id)):
+        ok = (gid >= 0) & (svc >= base)
+        pres = _scatter_add(
+            pres, jnp.where(ok, svc - base, -1),
+            jnp.ones(svc.shape, jnp.int32), False,
+        )
+    return pres > 0
+
+
+def overflow_service_presence(state: StoreState, n_over: int):
+    """Which dictionary-overflow service ids (>= max_services) are
+    present as annotation/binary-annotation hosts in the RINGS — the
+    service-listing criterion for services no presence array can
+    represent. Ring-resident (window) semantics, vs the lifetime
+    ann_svc_counts of indexed services: the only data that exists for
+    an overflow service lives in the raw ring columns. ``n_over`` is a
+    static pad (next pow2 of the dictionary overflow count) so dict
+    growth doesn't recompile per service."""
+    return _overflow_presence_impl(
+        state.config.max_services, n_over,
+        state.ann_gid, state.ann_service_id,
+        state.bann_gid, state.bann_service_id,
+    )
+
+
+def svc_scan_catalog(state: StoreState, svc_id: int):
+    """Ring-scan catalog aggregates for ONE service id — the query path
+    for dictionary-overflow services (id >= max_services), which no
+    [max_services]-sized catalog array (name_presence, svc_hist,
+    ann_value_counts, bann_key_counts) can represent: a clamped gather
+    there would silently serve service max_services-1's data under the
+    wrong name. Returns (span-name presence row, duration log-histogram
+    row, annotation-value counts row, binary-key counts row), computed
+    from ring-RESIDENT rows only — the indexed counterparts are
+    lifetime counters, so the overflow path is window-bounded: slower
+    and shorter-memoried, never wrong-service. All four aggregates ride
+    one launch (i32 1-D scatter-adds, the vectorized class on this
+    backend). Reference role: the per-service catalogs of
+    CassieSpanStore.scala (ServiceNames/SpanNames column families)."""
+    c = state.config
+    gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
+    return _svc_scan_catalog_impl(
+        (c.capacity, c.max_span_names, c.quantile_buckets,
+         c.max_annotation_values, c.max_binary_keys, gamma),
+        state.service_id, state.duration, state.row_gid,
+        state.ann_gid, state.ann_service_id, state.ann_value_id,
+        state.name_id, state.name_lc_id, state.indexable,
+        state.bann_gid, state.bann_service_id, state.bann_key_id,
+        jnp.int32(svc_id),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -1043,8 +1161,12 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     pos_b = pos_lo[b_c]
     slot = slot0.astype(jnp.int32) + ((pos_b + rank) % depth)
     # A kept write DISPLACES a previous entry iff its bucket has already
-    # wrapped past this slot — pos + rank >= depth — which replaces the
-    # old occupancy gather (old gid >= 0) exactly.
+    # wrapped past this slot — pos + rank >= depth. NOT identical to the
+    # old per-slot occupancy gather (gid >= 0): when an earlier batch
+    # overflowed a bucket (cnt > depth), its dropped rows never wrote
+    # their slots, so a cursor-"occupied" slot may still hold the INIT
+    # entry — whose values are chosen to be inert here (they lose every
+    # watermark war and match no key fingerprint; see init_state).
     occupied = keep & (pos_b + rank >= depth)
     gidx = jnp.where(keep, slot, 0)
     old_gid = entries[:, 0][gidx]

@@ -979,17 +979,58 @@ class TpuSpanStore(SpanStore):
         with self._rw.read():
             present = jax.device_get(self.state.ann_svc_counts) > 0
         d = self.dicts.services
-        return {
+        out = {
             d.decode(i) for i in np.flatnonzero(present)
             if i < len(d) and d.decode(i)
         }
+        # Dictionary-overflow services (id >= max_services) cannot mark
+        # the presence array — list the ones the rings still hold as
+        # annotation/binary hosts (the only data that exists for them;
+        # ring-window semantics vs the indexed services' lifetime
+        # counter, documented in dev.overflow_service_presence).
+        S = self.config.max_services
+        n_over = len(d) - S
+        if n_over > 0:
+            pad = 1 << max(0, (n_over - 1)).bit_length()
+            with self._rw.read():
+                pres = jax.device_get(
+                    dev.overflow_service_presence(self.state, pad)
+                )
+            out.update(
+                name for i in np.flatnonzero(pres[:n_over])
+                if (name := d.decode(S + int(i)))
+            )
+        return out
+
+    def _svc_catalog_scan(self, svc: int):
+        """One-launch ring-scan catalog rows for an overflow service
+        (see dev.svc_scan_catalog): (names, dur_hist, ann_values,
+        bann_keys). The [max_services]-sized catalog arrays cannot
+        represent these services, and a clamped gather would serve
+        service max_services-1's data under the wrong name.
+
+        The kernel computes all four rows per launch, so a one-entry
+        memo keyed on (svc, write position) lets a UI service page that
+        calls all four endpoints pay ONE O(ring) scan + D2H instead of
+        four."""
+        key = (svc, self._wp)
+        cached = getattr(self, "_svc_scan_memo", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        with self._rw.read():
+            rows = jax.device_get(dev.svc_scan_catalog(self.state, svc))
+        self._svc_scan_memo = (key, rows)
+        return rows
 
     def get_span_names(self, service: str) -> Set[str]:
         svc = self._svc_id(service)
         if svc is None:
             return set()
-        with self._rw.read():
-            row = jax.device_get(self.state.name_presence[svc]) > 0
+        if service_scan_only(svc, self.config):
+            row = self._svc_catalog_scan(svc)[0] > 0
+        else:
+            with self._rw.read():
+                row = jax.device_get(self.state.name_presence[svc]) > 0
         d = self.dicts.span_names
         return {
             d.decode(i) for i in np.flatnonzero(row)
@@ -1073,6 +1114,11 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service)
         if svc is None:
             return None
+        if service_scan_only(svc, self.config):
+            counts = self._svc_catalog_scan(svc)[1]
+            c = self.config
+            gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
+            return Q.quantiles_host(counts, gamma, 1.0, qs)
         with self._rw.read():
             hist = dev.svc_histogram(self.state)
             counts = jax.device_get(hist.counts[svc])
@@ -1082,8 +1128,11 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service)
         if svc is None:
             return []
-        with self._rw.read():
-            row = jax.device_get(self.state.ann_value_counts[svc])
+        if service_scan_only(svc, self.config):
+            row = self._svc_catalog_scan(svc)[2]
+        else:
+            with self._rw.read():
+                row = jax.device_get(self.state.ann_value_counts[svc])
         order = np.argsort(-row)[:k]
         d = self.dicts.annotations
         return [
@@ -1096,8 +1145,11 @@ class TpuSpanStore(SpanStore):
         svc = self._svc_id(service)
         if svc is None:
             return []
-        with self._rw.read():
-            row = jax.device_get(self.state.bann_key_counts[svc])
+        if service_scan_only(svc, self.config):
+            row = self._svc_catalog_scan(svc)[3]
+        else:
+            with self._rw.read():
+                row = jax.device_get(self.state.bann_key_counts[svc])
         order = np.argsort(-row)[:k]
         d = self.dicts.binary_keys
         return [
