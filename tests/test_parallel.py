@@ -11,6 +11,7 @@ from zipkin_tpu.ops import hll
 from zipkin_tpu.parallel.shard import (
     ShardedSpanStore,
     ShardedStore,
+    global_summary,
     stack_batches,
 )
 from zipkin_tpu.store import device as dev
@@ -154,6 +155,36 @@ def test_sharded_dep_links_survive_eviction(mesh):
         last_total = total
     expected = n * rounds * 4 * (gen.spans_per_trace - 1)
     assert last_total == expected
+
+
+def test_summary_dep_compaction_parity(mesh):
+    """The per-step dependency summary ships only the top-k live cells
+    across the mesh (psum counts → top_k → all_gather k rows) instead
+    of the full [S*S, 5] bank; the result must equal the full gather
+    bit-for-bit, and the overflow fallback (live cells > k) must stay
+    lossless (VERDICT r4 weak #7)."""
+    store = ShardedStore(mesh, CFG)
+    helper = TpuSpanStore(CFG)
+    gen = ColumnarTraceGen(helper.dicts, n_services=8, n_span_names=16)
+    store.ingest(_shard_batches(mesh, gen))
+    full = global_summary(store.states, mesh, dep_k=None)
+    want = np.asarray(full["dep_moments"])
+    # Branch preconditions, asserted so geometry drift can't silently
+    # turn this into full-vs-full: dep_k must sit strictly between the
+    # live-cell count (compact branch taken) and the cell count (the
+    # Python dep_k >= cells shortcut not taken); the overflow probe
+    # needs nz > 1 to take the lax.cond fallback.
+    nz = int((want[:, 0] > 0).sum())
+    cells = want.shape[0]
+    dep_k = 128
+    assert 1 < nz <= dep_k < cells, (nz, dep_k, cells)
+    compact = global_summary(store.states, mesh, dep_k=dep_k)
+    overflow = global_summary(store.states, mesh, dep_k=1)  # nz > k
+    assert want[:, 0].sum() > 0
+    np.testing.assert_array_equal(
+        np.asarray(compact["dep_moments"]), want)
+    np.testing.assert_array_equal(
+        np.asarray(overflow["dep_moments"]), want)
 
 
 def test_sharded_multi_query_matches_singular(mesh):
