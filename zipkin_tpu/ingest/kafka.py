@@ -45,8 +45,16 @@ Producer side (``KafkaSpanSink``):
 
 ``connect_kafka_python`` below wires all of this to kafka-python when
 that library is importable (it is not baked into this environment —
-the function degrades to a clear error, and everything above is
-exercised against injected transports in tests/test_ingest.py).
+the function degrades to a clear error). The semantics above are
+exercised two ways: against injected transports (tests/test_ingest.py)
+and against BYTES ON A SOCKET via the v0 wire-protocol broker fake +
+minimal real-protocol client in zipkin_tpu/testing/kafka_fake.py
+(tests/test_kafka_wire.py) — framing, CRC, batching, pushback retry,
+corrupt payloads, and at-least-once redelivery all cross a real TCP
+connection. MinimalKafkaProducer/MinimalKafkaConsumer speak protocol
+v0 only, one partition, no consumer group — a test/dev transport for
+the in-process fake, NOT a client for production brokers (modern Kafka
+has removed the v0 message format; use kafka-python there).
 """
 
 from __future__ import annotations

@@ -165,13 +165,22 @@ class _Handler(socketserver.BaseRequestHandler):
 
     @staticmethod
     def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-        buf = b""
-        while len(buf) < n:
+        return read_exact(sock, n)
+
+
+def read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes or None on disconnect/socket error —
+    shared by every framed-TCP server here (scribe, the kafka fake)."""
+    buf = b""
+    while len(buf) < n:
+        try:
             chunk = sock.recv(n - len(buf))
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
 
 
 class ScribeServer(socketserver.ThreadingTCPServer):
