@@ -99,6 +99,23 @@ class TestRoutes:
         status, _ = app.handle("GET", "/api/trace/999", {})
         assert status == 404
 
+    def test_quantiles_route(self, app):
+        status, body = app.handle(
+            "GET", "/api/quantiles",
+            {"serviceName": "api", "q": ["0.5,0.99"]},
+        )
+        assert status == 200
+        assert body["quantiles"] == [0.5, 0.99]
+        # The fixture store may or may not expose the histogram; the
+        # contract is the shape: None or one duration per quantile.
+        vals = body["durationsMicro"]
+        assert vals is None or (
+            len(vals) == 2 and all(v >= 0 for v in vals))
+
+    def test_quantiles_requires_service(self, app):
+        status, _ = app.handle("GET", "/api/quantiles", {})
+        assert status == 400
+
     def test_dependencies_shape(self, app):
         status, body = app.handle("GET", "/api/dependencies", {})
         assert status == 200 and "links" in body

@@ -202,6 +202,19 @@ class ApiServer:
         if path == "/api/top_kv_annotations":
             return 200, self.query.get_top_key_value_annotations(
                 _require(params, "serviceName"))
+        if path == "/api/quantiles":
+            qs = [float(x) for x in
+                  params.get("q", ["0.5,0.95,0.99"])[0].split(",")]
+            vals = self.query.get_service_duration_quantiles(
+                _require(params, "serviceName"), qs)
+            # An empty histogram yields NaNs, which json.dumps would
+            # emit as BARE NaN — invalid JSON that breaks JSON.parse
+            # in the browser. No data serializes as null.
+            if vals is not None:
+                vals = [round(v, 1) for v in vals]
+                if any(v != v for v in vals):
+                    vals = None
+            return 200, {"quantiles": qs, "durationsMicro": vals}
         if path == "/api/dependencies" or re.match(r"^/api/dependencies/", path):
             return self._dependencies(path, params)
         # Trace ids in paths are unsigned hex (upstream zipkin URL

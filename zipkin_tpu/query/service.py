@@ -218,6 +218,13 @@ class QueryService:
         getter = getattr(self.store, "top_binary_keys", None)
         return [a for a, _ in getter(service, k)] if getter else []
 
+    def get_service_duration_quantiles(self, service: str, qs):
+        """Per-service latency percentiles off the device histogram
+        (BASELINE config #4; the aggregates-page data the reference
+        computed offline). Stores without the histogram return None."""
+        getter = getattr(self.store, "service_duration_quantiles", None)
+        return getter(service, list(qs)) if getter else None
+
     def set_trace_time_to_live(self, trace_id: int, ttl_s: float) -> None:
         self.store.set_time_to_live(trace_id, ttl_s)
 
