@@ -655,7 +655,12 @@ def bench_checkpoint(store):
         path = tempfile.mkdtemp(dir=parent)
     try:
         t0 = time.perf_counter()
-        ckpt.save(store, path)
+        # Chunked + resumable D2H: <=64MB slabs, each under its own
+        # deadline with one retry; a wedged slab costs a bounded wait
+        # and the staged leaves survive for the next attempt (r4: one
+        # monolithic 544MB device_get hung >70 min).
+        xfer = ckpt.save(store, path, chunk_deadline_s=240,
+                         slab_retries=1)
         save_s = time.perf_counter() - t0
         size_mb = sum(
             f.stat().st_size for f in __import__("pathlib").Path(path)
@@ -669,10 +674,15 @@ def bench_checkpoint(store):
         del restored
     finally:
         shutil.rmtree(path, ignore_errors=True)
+        # A wedged chunked save leaves its staged leaves beside the
+        # path; this bench's paths are per-run mkdtemp names, so the
+        # stage can never be resumed — reclaim it.
+        shutil.rmtree(path + ".staging", ignore_errors=True)
     out = {
         "save_s": round(save_s, 2), "load_s": round(load_s, 2),
         "snapshot_mb": round(size_mb, 1),
         "query_parity": before == after,
+        "d2h": xfer,
     }
     _log(f"checkpoint: save {save_s:.1f}s, load {load_s:.1f}s, "
          f"{size_mb:.0f}MB, parity={before == after}")

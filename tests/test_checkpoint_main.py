@@ -118,6 +118,127 @@ class TestCheckpoint:
                     for l in restored.get_dependencies().links)
         assert after >= before + 1  # the orphan child linked
 
+    def test_chunked_save_resumes_after_wedged_transfer(self, tmp_path,
+                                                        monkeypatch):
+        """A transfer that wedges mid-save (r4: one 544MB device_get
+        hung >70 min) must cost the failed leaves only: the staged
+        leaves survive on disk, and a retry with an unchanged state
+        generation skips them and completes a CONSISTENT snapshot."""
+        store = TpuSpanStore(CFG)
+        store.apply([rpc(1, 1, None, 100, 200), rpc(1, 2, 1, 110, 150)])
+        path = str(tmp_path / "ckpt")
+
+        real_get = checkpoint._bounded_get
+        fail = {"after": 5}  # wedge every transfer past the 5th
+
+        def flaky(x, deadline_s):
+            if deadline_s is not None and fail["after"] <= 0:
+                raise TimeoutError("simulated wedge")
+            fail["after"] -= 1
+            return real_get(x, None)
+
+        monkeypatch.setattr(checkpoint, "_bounded_get", flaky)
+        with pytest.raises(TimeoutError):
+            checkpoint.save(store, path, chunk_deadline_s=5.0,
+                            slab_retries=0)
+        staging = path + ".staging"
+        assert __import__("os").path.isdir(staging)
+        assert not __import__("os").path.isdir(path)  # nothing partial
+
+        # Retry with a healthy tunnel: staged leaves are reused.
+        monkeypatch.setattr(checkpoint, "_bounded_get", real_get)
+        stats = checkpoint.save(store, path, chunk_deadline_s=5.0)
+        assert stats["resumed_leaves"] > 0
+        assert not __import__("os").path.isdir(staging)  # cleaned up
+        restored = checkpoint.load(path)
+        assert restored.get_spans_by_trace_ids([1]) == \
+            store.get_spans_by_trace_ids([1])
+        assert restored.counters() == store.counters()
+
+    def test_stale_staging_discarded_after_writes(self, tmp_path,
+                                                  monkeypatch):
+        """Writes between save attempts change the state generation:
+        the stale staged leaves must be DISCARDED, never mixed into the
+        new cut (a mixed snapshot would be silently inconsistent)."""
+        store = TpuSpanStore(CFG)
+        store.apply([rpc(1, 1, None, 100, 200)])
+        path = str(tmp_path / "ckpt")
+
+        real_get = checkpoint._bounded_get
+        fail = {"after": 5}
+
+        def flaky(x, deadline_s):
+            if deadline_s is not None and fail["after"] <= 0:
+                raise TimeoutError("simulated wedge")
+            fail["after"] -= 1
+            return real_get(x, None)
+
+        monkeypatch.setattr(checkpoint, "_bounded_get", flaky)
+        with pytest.raises(TimeoutError):
+            checkpoint.save(store, path, chunk_deadline_s=5.0,
+                            slab_retries=0)
+        monkeypatch.setattr(checkpoint, "_bounded_get", real_get)
+        store.apply([rpc(2, 3, None, 300, 400)])  # generation changes
+        stats = checkpoint.save(store, path, chunk_deadline_s=5.0)
+        assert stats["resumed_leaves"] == 0  # stale stage discarded
+        restored = checkpoint.load(path)
+        assert restored.get_spans_by_trace_ids([2]) == \
+            store.get_spans_by_trace_ids([2])
+
+    def test_sweep_between_attempts_discards_staging(self, tmp_path,
+                                                     monkeypatch):
+        """dep_sweep mutates dep_window/pend_key while moving NO write
+        cursor — the one mutation a cursor-only fingerprint would miss
+        (review r5). The device-side sweeps counter must change the
+        generation so stale staged leaves are discarded, not mixed."""
+        store = TpuSpanStore(CFG)
+        # A child whose parent arrives later leaves pending-ring state
+        # for the sweep to fold.
+        store.apply([rpc(1, 2, 7, 110, 150)])
+        store.apply([rpc(1, 7, None, 100, 200)])
+        path = str(tmp_path / "ckpt")
+        real_get = checkpoint._bounded_get
+        fail = {"after": 5}
+
+        def flaky(x, deadline_s):
+            if deadline_s is not None and fail["after"] <= 0:
+                raise TimeoutError("simulated wedge")
+            fail["after"] -= 1
+            return real_get(x, None)
+
+        monkeypatch.setattr(checkpoint, "_bounded_get", flaky)
+        with pytest.raises(TimeoutError):
+            checkpoint.save(store, path, chunk_deadline_s=5.0,
+                            slab_retries=0)
+        monkeypatch.setattr(checkpoint, "_bounded_get", real_get)
+        before = int(store.counters()["sweeps"])
+        store.get_dependencies()  # triggers the pending sweep
+        assert int(store.counters()["sweeps"]) > before
+        stats = checkpoint.save(store, path, chunk_deadline_s=5.0)
+        assert stats["resumed_leaves"] == 0  # sweep changed generation
+        restored = checkpoint.load(path)
+        got = {(l.parent, l.child)
+               for l in restored.get_dependencies().links}
+        assert got == {(l.parent, l.child)
+                       for l in store.get_dependencies().links}
+
+    def test_chunked_save_slabs_large_leaves(self, tmp_path,
+                                             monkeypatch):
+        """Leaves larger than the slab budget transfer in pieces and
+        reassemble bit-exactly."""
+        monkeypatch.setattr(checkpoint, "_SLAB_BYTES", 1 << 12)
+        store = TpuSpanStore(CFG)
+        store.apply([rpc(1, 1, None, 100, 200), rpc(1, 2, 1, 110, 150)])
+        path = str(tmp_path / "ckpt")
+        stats = checkpoint.save(store, path, chunk_deadline_s=30.0)
+        # 4KB slabs over >=several-hundred-KB state: many slabs.
+        assert stats["slabs"] > 50
+        assert stats["mb_per_s_avg"] > 0
+        restored = checkpoint.load(path)
+        assert restored.get_spans_by_trace_ids([1]) == \
+            store.get_spans_by_trace_ids([1])
+        assert restored.counters() == store.counters()
+
     def test_atomic_overwrite(self, tmp_path):
         store = TpuSpanStore(CFG)
         store.apply([rpc(1, 1, None, 100, 200)])

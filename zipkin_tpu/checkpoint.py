@@ -90,26 +90,197 @@ def _savez_fast(path: str, leaves: dict) -> None:
                 )
 
 
-def save(store, path: str) -> None:
+_SLAB_BYTES = 64 << 20  # transfer granularity for big leaves
+_GEN_FILE = "generation.json"
+
+
+def _bounded_get(x, deadline_s: Optional[float]):
+    """jax.device_get with a deadline. A wedged tunnel transfer is
+    uninterruptible from Python (round 4: one 544 MB device_get hung
+    >70 min after completing in ~6 min earlier the same day), so the
+    fetch runs on an abandonable daemon thread; on timeout the thread
+    is orphaned and TimeoutError raised — the caller retries or gives
+    up, but never loses work already staged to disk."""
+    if deadline_s is None:
+        return jax.device_get(x)
+    import threading
+
+    box = {}
+
+    def run():
+        try:
+            box["v"] = jax.device_get(x)
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            box["e"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        raise TimeoutError(
+            f"device_get exceeded {deadline_s:.0f}s (wedged transfer?)")
+    if "e" in box:
+        raise box["e"]
+    return box["v"]
+
+
+def _fetch_leaf(arr, deadline_s, retries: int, stats: Optional[dict]):
+    """Fetch one device leaf as slabs of <= _SLAB_BYTES (sliced on
+    device along the leading axis), each slab under its own deadline
+    with per-slab retry — a transient wedge costs one slab re-request,
+    not the snapshot."""
+    import time
+
+    nbytes = arr.size * getattr(arr, "dtype", np.dtype(np.int64)).itemsize
+    shape = getattr(arr, "shape", ())
+    if deadline_s is None or not shape or nbytes <= _SLAB_BYTES:
+        slabs = [arr]
+    else:
+        rows = shape[0]
+        row_bytes = max(1, nbytes // max(rows, 1))
+        step = max(1, _SLAB_BYTES // row_bytes)
+        slabs = [arr[i:i + step] for i in range(0, rows, step)]
+    out = []
+    for slab in slabs:
+        for attempt in range(retries + 1):
+            t0 = time.perf_counter()
+            try:
+                h = _bounded_get(slab, deadline_s)
+                break
+            except TimeoutError:
+                if stats is not None:
+                    stats["slab_timeouts"] = stats.get("slab_timeouts",
+                                                      0) + 1
+                if attempt == retries:
+                    raise
+                # Best-effort: the retry enqueues BEHIND the wedged
+                # transfer on a one-at-a-time tunnel, so it only helps
+                # when the wedge un-sticks; a short backoff gives it
+                # that chance. The real recovery is the staged resume.
+                time.sleep(min(10.0, deadline_s / 10))
+        dt = time.perf_counter() - t0
+        h = np.asarray(h)
+        if stats is not None:
+            stats["slabs"] = stats.get("slabs", 0) + 1
+            stats["bytes"] = stats.get("bytes", 0) + h.nbytes
+            stats["slab_s"] = stats.get("slab_s", 0.0) + dt
+            mbps = h.nbytes / 1e6 / max(dt, 1e-9)
+            stats["mb_per_s_min"] = round(min(
+                stats.get("mb_per_s_min", mbps), mbps), 2)
+            stats["mb_per_s_max"] = round(max(
+                stats.get("mb_per_s_max", mbps), mbps), 2)
+        out.append(h)
+    return out[0] if len(out) == 1 else np.concatenate(out, axis=0)
+
+
+def _state_generation(store, n_shards, deadline_s) -> list:
+    """A cheap scalar fingerprint of the device state's write history:
+    equal generations mean no ingest/sweep/archive touched the state
+    between two save attempts, so staged leaves from the earlier
+    attempt are still a consistent cut and may be reused."""
+    state = store.states if n_shards else store.state
+    gen = {
+        "write_pos": state.write_pos,
+        "ann_write_pos": state.ann_write_pos,
+        "bann_write_pos": state.bann_write_pos,
+        "pend_pos": state.pend_pos,
+        "dep_bank_seq": state.dep_bank_seq,
+        "ts_max": state.ts_max,
+        **{f"counters.{k}": v for k, v in state.counters.items()},
+    }
+    host = _bounded_get(gen, deadline_s)
+    # Lists, not tuples: the fingerprint round-trips through JSON and
+    # must compare equal to its own deserialization.
+    return sorted(
+        [k, np.asarray(v).reshape(-1).tolist()] for k, v in host.items()
+    )
+
+
+def save(store, path: str, chunk_deadline_s: Optional[float] = None,
+         slab_retries: int = 1) -> dict:
     """Snapshot a TpuSpanStore OR a ShardedSpanStore to ``path`` (a
     directory), atomically. Sharded stores save their stacked
-    [n_shards, ...] state; load() re-shards it over a mesh."""
+    [n_shards, ...] state; load() re-shards it over a mesh.
+
+    With ``chunk_deadline_s`` set, the device→host gather is CHUNKED
+    and RESUMABLE: each leaf transfers in <= 64 MB slabs, each under
+    its own deadline (+ ``slab_retries`` re-requests), and completed
+    leaves persist in a ``<path>.staging`` directory — if a degraded
+    tunnel wedges a transfer, the failed save raises but a retry skips
+    everything already staged (guarded by a state-generation
+    fingerprint so a write between attempts discards the stage rather
+    than mixing two cuts). Returns transfer stats (slab count/bytes/
+    bandwidth, resumed leaf count)."""
     n_shards = getattr(store, "n", None) if hasattr(store, "states") else None
+    stats: dict = {"resumed_leaves": 0, "chunked": chunk_deadline_s
+                   is not None}
+    staging = os.path.abspath(path) + ".staging"
     leaves = {}
-    # Hold the read lock only for the gather: ingest donates the
-    # previous state's buffers, so an unguarded snapshot could read
-    # freed memory. One batched device_get of the whole pytree, not a
-    # transfer per field — writers block on _rw for its duration.
-    with store._rw.read():
-        state = store.states if n_shards else store.state
-        host_state = jax.device_get(state)
-    for name in dev.StoreState._FIELDS:
-        value = getattr(host_state, name)
-        if name == "counters":
-            for k, v in value.items():
-                leaves[f"counters.{k}"] = np.asarray(v)
-        else:
-            leaves[name] = np.asarray(value)
+    if chunk_deadline_s is None:
+        # Fast path (the default, e.g. the daemon's SIGTERM save): ONE
+        # batched device_get of the whole pytree under the read lock —
+        # per-leaf transfers and a staged double-write would be a pure
+        # latency/IO regression for callers that never asked for
+        # resumability. Ingest donates the previous state's buffers, so
+        # the lock must cover the gather.
+        with store._rw.read():
+            state = store.states if n_shards else store.state
+            host_state = jax.device_get(state)
+        for name in dev.StoreState._FIELDS:
+            value = getattr(host_state, name)
+            if name == "counters":
+                for k, v in value.items():
+                    leaves[f"counters.{k}"] = np.asarray(v)
+            else:
+                leaves[name] = np.asarray(value)
+    else:
+        # Chunked+resumable path. The read lock covers the whole
+        # gather (consistent cut; writers block). CAVEAT on timeout:
+        # the orphaned transfer thread may still be reading state
+        # buffers after the lock releases — like bench.py's _bounded,
+        # a TimeoutError here means the caller must treat the DEVICE
+        # side as suspect and not resume donating writes until the
+        # process restarts or a fresh probe succeeds; schedule
+        # deadline-bounded saves last (axon tunnel discipline).
+        with store._rw.read():
+            gen = _state_generation(store, n_shards, chunk_deadline_s)
+            if os.path.isdir(staging):
+                try:
+                    with open(os.path.join(staging, _GEN_FILE)) as f:
+                        prior = json.load(f)
+                except (OSError, ValueError):
+                    prior = None
+                if prior != gen:
+                    shutil.rmtree(staging, ignore_errors=True)
+            os.makedirs(staging, exist_ok=True)
+            with open(os.path.join(staging, _GEN_FILE), "w") as f:
+                json.dump(gen, f)
+            state = store.states if n_shards else store.state
+            for name in dev.StoreState._FIELDS:
+                value = getattr(state, name)
+                items = ([(f"counters.{k}", v) for k, v in value.items()]
+                         if name == "counters" else [(name, value)])
+                for key, leaf in items:
+                    dest = os.path.join(staging, key + ".npy")
+                    if os.path.exists(dest):
+                        stats["resumed_leaves"] += 1
+                        continue
+                    host = _fetch_leaf(leaf, chunk_deadline_s,
+                                       slab_retries, stats)
+                    tmp_leaf = dest + ".tmp"
+                    with open(tmp_leaf, "wb") as f:
+                        np.save(f, host, allow_pickle=False)
+                    os.replace(tmp_leaf, dest)
+        if stats.get("slab_s"):
+            stats["mb_per_s_avg"] = round(
+                stats["bytes"] / 1e6 / stats["slab_s"], 2)
+        for fname in os.listdir(staging):
+            if fname.endswith(".npy"):
+                # mmap: the finalize zip streams straight from the
+                # staged files instead of doubling the snapshot in RAM.
+                leaves[fname[:-4]] = np.load(
+                    os.path.join(staging, fname), mmap_mode="r",
+                    allow_pickle=False)
     with store._lock:
         # Pinned traces' eviction-exempt banks must survive restarts —
         # the TTL alone restoring while the spans vanish would break the
@@ -157,9 +328,13 @@ def save(store, path: str) -> None:
             os.replace(path, old)
         os.replace(tmp, path)
         shutil.rmtree(old, ignore_errors=True)
+        # The staged cut is fully inside the finalized snapshot now.
+        del leaves
+        shutil.rmtree(staging, ignore_errors=True)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    return stats
 
 
 def load(path: str, mesh=None):

@@ -699,6 +699,10 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
             # (table congestion). While 0, an absent key record PROVES
             # the key was never indexed — the negative-lookup gate.
             "key_claim_drops": jnp.int64(0),
+            # Pending-sweep count: the only mutation that moves no
+            # write cursor, counted so checkpoint._state_generation
+            # can detect it (staged-leaf reuse safety).
+            "sweeps": jnp.int64(0),
         },
     )
 
@@ -1386,7 +1390,14 @@ def dep_sweep(state: "StoreState") -> "StoreState":
     dependency reads, and on the collector's timer."""
     window, window_ts, cleared = _sweep_core(state)
     return state.replace(
-        dep_window=window, dep_window_ts=window_ts, pend_key=cleared
+        dep_window=window, dep_window_ts=window_ts, pend_key=cleared,
+        # The sweep mutates state without moving any write cursor, so
+        # it must bump a counter: checkpoint._state_generation decides
+        # staged-leaf reuse from counters + cursors alone, and a sweep
+        # between two save attempts would otherwise silently mix two
+        # inconsistent cuts.
+        counters={**state.counters,
+                  "sweeps": state.counters["sweeps"] + 1},
     )
 
 
@@ -1425,6 +1436,9 @@ def dep_close_bucket(state: "StoreState") -> "StoreState":
         dep_window=jnp.where(rotate, jnp.zeros_like(window), window),
         dep_window_ts=jnp.where(rotate, empty_ts, window_ts),
         pend_key=cleared,
+        # An un-rotated close still sweeps — see dep_sweep's counter.
+        counters={**state.counters,
+                  "sweeps": state.counters["sweeps"] + 1},
     )
 
 
@@ -1916,7 +1930,10 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     lasts = jnp.where(mask & (b.ts_last >= 0), b.ts_last, I64_MIN)
     upd["ts_min"] = jnp.minimum(state.ts_min, firsts.min())
     upd["ts_max"] = jnp.maximum(state.ts_max, lasts.max())
+    # Spread-then-update: counters the step doesn't touch (sweeps)
+    # must carry through, not silently reset to absent.
     upd["counters"] = {
+        **state.counters,
         "spans_seen": state.counters["spans_seen"] + b.n_spans,
         "anns_seen": state.counters["anns_seen"] + b.n_anns,
         "banns_seen": state.counters["banns_seen"] + b.n_banns,
