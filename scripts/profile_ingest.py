@@ -146,7 +146,7 @@ def main():
             b_base, s_base, n_b, depth = lay[fam]
             lb = jnp.clip(local_bucket, 0, n_b - 1)
             n = lb.shape[0]
-            return (
+            return fam, (
                 lb.astype(jnp.int32) + jnp.int32(b_base),
                 lb.astype(jnp.int64) * depth + jnp.int64(s_base),
                 jnp.full(n, depth, jnp.int32),
@@ -154,7 +154,6 @@ def main():
                 jnp.asarray(verify, jnp.int64),
                 jnp.asarray(ts, jnp.int64),
                 ok,
-                jnp.full(n, fam != StoreConfig.CAND_SVC, bool),
             )
 
         segments = [seg(StoreConfig.CAND_SVC, a_host, gid_a, a_host,
@@ -203,10 +202,14 @@ def main():
                 jnp.where(ok, span_gid_of_bann, -1), _verify_of(mix),
                 ts_b, ok,
             ))
-        cat = [jnp.concatenate(parts) for parts in zip(*segments)]
+        fams = [f for f, _ in segments]
+        assert (fams[0] == StoreConfig.CAND_SVC
+                and StoreConfig.CAND_SVC not in fams[1:]), fams
+        cat = [jnp.concatenate(parts)
+               for parts in zip(*(p for _, p in segments))]
         out = dev._index_write(
             st.cand_idx, st.cand_pos, st.cand_wm, st.key_tab, st.key_wm,
-            *cat
+            *cat, keyed_from=segments[0][1][0].shape[0]
         )
         return out[0].sum()
 

@@ -1127,7 +1127,7 @@ def _fifo_ranks(bucket, valid, n_buckets: int):
 
 
 def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
-                 depth, gid, verify, ts, valid, keyed):
+                 depth, gid, verify, ts, valid, keyed_from: int):
     """ONE combined append of (gid, verify, ts) rows into the unified
     candidate-family entry array: ``gbucket`` is the global bucket id
     (addressing pos/wm), ``slot0`` the bucket's first entry row, and
@@ -1143,13 +1143,16 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     candidate still ranks >= the watermark.
 
     ``key_tab``/``key_wm`` is the per-key cursor table (see
-    StoreState.key_tab); rows with ``keyed`` claim a record for their
-    verify word, and every displaced or in-batch-dropped keyed entry
-    scatter-maxes its span gid into its key's displaced watermark.
-    Also returns the number of keyed rows whose claim found no slot
-    (table congestion): while that count is ZERO over the store's
-    lifetime, an ABSENT record proves its key was never indexed — the
-    negative-lookup gate (see iquery wrappers)."""
+    StoreState.key_tab); rows from ``keyed_from`` on (the keyed
+    families are a contiguous SUFFIX of the concatenation — the
+    service family, whose bucket IS the key, comes first) claim a
+    record for their verify word, and every displaced or
+    in-batch-dropped keyed entry scatter-maxes its span gid into its
+    key's displaced watermark. Also returns the number of keyed rows
+    whose claim found no slot (table congestion): while that count is
+    ZERO over the store's lifetime, an ABSENT record proves its key
+    was never indexed — the negative-lookup gate (see iquery
+    wrappers)."""
     n_b = pos.shape[0]
     rank = _fifo_ranks(gbucket, valid, n_b)
     b_c = jnp.clip(gbucket, 0, n_b - 1)
@@ -1173,9 +1176,13 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     # watermark war and match no key fingerprint; see init_state).
     occupied = keep & (pos_b + rank >= depth)
     gidx = jnp.where(keep, slot, 0)
-    old_gid = entries[:, 0][gidx]
-    old_verify = entries[:, 1][gidx]
     old_ts = jnp.where(occupied, entries[:, 2][gidx], I64_MIN)
+    # Old entry identity is only consumed by the (suffix-only) key
+    # machinery below — gather the suffix, not the full concatenation.
+    sfx = slice(keyed_from, None)
+    gidx_s = gidx[sfx]
+    old_gid_s = entries[:, 0][gidx_s]
+    old_verify_s = entries[:, 1][gidx_s]
     dropped_ts = jnp.where(valid & ~keep, jnp.asarray(ts, jnp.int64),
                            I64_MIN)
     wm = _war_max64(wm, oob_b, jnp.maximum(old_ts, dropped_ts), valid)
@@ -1185,7 +1192,7 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     entries = _uset_cols64(entries, slot, vals, keep)
     pos = pos + cnt.astype(pos.dtype)
 
-    # -- per-key fingerprint records -----------------------------------
+    # -- per-key fingerprint records (suffix rows only) ----------------
     # 1. Claim records for this batch's keys: empty slots only, i32
     #    fingerprint min-war arbitration (duplicate-index i32 scatters
     #    vectorize; the old exact-word i64 war serialized at ~100 ns/row
@@ -1196,42 +1203,68 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     #    a watermark: extra fallbacks, never a wrong answer. The
     #    negative-lookup gate stays sound: an indexed key either placed
     #    a record its probes will find (fp match) or counted a drop.
+    #
+    #    All three probe slots are read in ONE stacked gather and the
+    #    claim goes to the first EMPTY probe; rows that lose the
+    #    in-batch min-war at their chosen slot retry (next empty probe
+    #    under the updated table) in a lax.cond round that costs
+    #    nothing once the key population is resident — the round-4
+    #    3-sequential-probe loop paid 3 gather+scatter+gather rounds
+    #    on EVERY step forever. Probe-exhaustion semantics (and the
+    #    drop count) are identical: initial + 2 retries = 3 attempts.
     T = key_tab.shape[0]
-    ins_ok = valid & jnp.asarray(keyed, bool)
-    k48n = verify.astype(jnp.uint64) >> jnp.uint64(16)
+    v_s = valid[sfx]
+    verify_s = verify[sfx]
+    k48n = verify_s.astype(jnp.uint64) >> jnp.uint64(16)
     fp = _fp31(k48n)
-    placed = ~ins_ok
-    for kslot in _tab_slots(k48n, T)[:_KEY_PROBES]:
-        cur = key_tab[kslot]
-        open_ = (cur == _FP_EMPTY) | (cur == fp)
-        attempt = ~placed & open_
-        key_tab = key_tab.at[jnp.where(attempt, kslot, T)].min(
+    slots3 = jnp.stack(_tab_slots(k48n, T)[:_KEY_PROBES])  # [3, M]
+
+    def claim_round(key_tab, placed):
+        cur = key_tab[slots3]                 # one gather, 3M rows
+        already = (cur == fp[None, :]).any(0)
+        empty = cur == _FP_EMPTY
+        choose = jnp.full(fp.shape, T, jnp.int32)
+        for i in range(_KEY_PROBES - 1, -1, -1):
+            choose = jnp.where(empty[i], slots3[i], choose)
+        attempt = v_s & ~placed & ~already & (choose < T)
+        key_tab = key_tab.at[jnp.where(attempt, choose, T)].min(
             jnp.where(attempt, fp, _FP_EMPTY), mode="drop"
         )
-        after = key_tab[kslot]
-        placed |= attempt & (after == fp)
+        after = key_tab[jnp.where(attempt, choose, 0)]
+        placed = placed | already | (attempt & (after == fp))
+        # Lost the same-batch min-war at a still-open table: retryable.
+        unresolved = attempt & ~placed
+        return key_tab, placed, unresolved
+
+    placed = jnp.zeros(fp.shape, bool)
+    key_tab, placed, unresolved = claim_round(key_tab, placed)
+    for _ in range(_KEY_PROBES - 1):
+        key_tab, placed, unresolved = jax.lax.cond(
+            unresolved.any(),
+            claim_round,
+            lambda kt, pl: (kt, pl, jnp.zeros_like(pl)),
+            key_tab, placed,
+        )
     # 2. Record displacements: bucket-wrap victims carry their OLD
     #    entry's (verify, gid); in-batch overflow drops carry their own.
     #    The displaced gid must be the TRUE old gid (not the current
     #    row's): a busy key's displaced entries are ~2 window-laps old
     #    and already evicted, which is exactly what keeps its record's
     #    eviction gate passing in steady state.
-    disp_ok = jnp.asarray(keyed, bool) & (
-        (keep & occupied) | (valid & ~keep)
-    )
-    disp_key = jnp.where(keep, old_verify, verify)
-    disp_gid = jnp.where(keep, old_gid, gid)
+    keep_s = keep[sfx]
+    disp_ok = (keep_s & occupied[sfx]) | (v_s & ~keep_s)
+    disp_key = jnp.where(keep_s, old_verify_s, verify_s)
+    disp_gid = jnp.where(keep_s, old_gid_s, gid[sfx])
     k48d = disp_key.astype(jnp.uint64) >> jnp.uint64(16)
     fpd = _fp31(k48d)
+    dslots3 = jnp.stack(_tab_slots(k48d, T)[:_KEY_PROBES])
+    dhit = key_tab[dslots3] == fpd[None, :]   # one gather, 3M rows
     dslot = jnp.full(k48d.shape, T, jnp.int32)
-    dfound = jnp.zeros(k48d.shape, bool)
-    for kslot in _tab_slots(k48d, T)[:_KEY_PROBES]:
-        cur = key_tab[kslot]
-        hit = ~dfound & (cur == fpd)
-        dslot = jnp.where(hit, kslot, dslot)
-        dfound |= hit
-    key_wm = _war_max64(key_wm, dslot, disp_gid, disp_ok & dfound)
-    n_drops = (ins_ok & ~placed).sum().astype(jnp.int64)
+    for i in range(_KEY_PROBES - 1, -1, -1):
+        dslot = jnp.where(dhit[i], dslots3[i], dslot)
+    key_wm = _war_max64(key_wm, dslot, disp_gid,
+                        disp_ok & dhit.any(0))
+    n_drops = (v_s & ~placed).sum().astype(jnp.int64)
     return entries, pos, wm, key_tab, key_wm, n_drops
 
 
@@ -1731,11 +1764,13 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
             bucket, first-slot row, depth vectors + the entry payload.
             The service family is not per-key-tracked (its bucket IS the
             key — no aliasing — and its verify words are raw service ids
-            whose key48 would all collide)."""
+            whose key48 would all collide); it MUST stay the first
+            segment — _index_write takes the keyed families as the
+            suffix from ``keyed_from``."""
             b_base, s_base, n_b, depth = lay[fam]
             lb = jnp.clip(local_bucket, 0, n_b - 1)
             n = lb.shape[0]
-            return (
+            return fam, (
                 lb.astype(jnp.int32) + jnp.int32(b_base),
                 lb.astype(jnp.int64) * depth + jnp.int64(s_base),
                 jnp.full(n, depth, jnp.int32),
@@ -1743,7 +1778,6 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
                 jnp.asarray(verify, jnp.int64),
                 jnp.asarray(ts, jnp.int64),
                 ok,
-                jnp.full(n, fam != StoreConfig.CAND_SVC, bool),
             )
 
         segments = []
@@ -1813,11 +1847,20 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
                 jnp.where(ok, span_gid_of_bann, -1), _verify_of(mix),
                 ts_b, ok,
             ))
-        cat = [jnp.concatenate(parts) for parts in zip(*segments)]
+        # keyed_from depends on the un-keyed SVC family being the SINGLE
+        # leading segment; a reorder would silently poison the key table
+        # (service verify words all collide in key48 space) — assert the
+        # invariant structurally, at trace time.
+        fams = [f for f, _ in segments]
+        assert (fams[0] == StoreConfig.CAND_SVC
+                and StoreConfig.CAND_SVC not in fams[1:]), fams
+        cat = [jnp.concatenate(parts)
+               for parts in zip(*(p for _, p in segments))]
         (upd["cand_idx"], upd["cand_pos"], upd["cand_wm"],
          upd["key_tab"], upd["key_wm"], n_key_drops) = _index_write(
             state.cand_idx, state.cand_pos, state.cand_wm,
-            state.key_tab, state.key_wm, *cat
+            state.key_tab, state.key_wm, *cat,
+            keyed_from=segments[0][1][0].shape[0],
         )
         # Trace-membership family: row gids bucketed by trace-id hash,
         # one sub-family per ring (whole-trace fetch + durations).
