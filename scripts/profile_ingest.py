@@ -285,6 +285,54 @@ def main():
 
     timeit("HLL + CMS update", jax.jit(sketch_only), state, b)
 
+    # 8b. micro-arms for the remaining _index_write costs: which gather
+    # shape is cheapest for the old-entry read, what the rank sort
+    # costs alone, and what one full-width war costs.
+    NR = 4 * PA + 4 * PB  # concatenated candidate rows
+    M_ROWS = config.cand_layout[2]
+    # Hash-scattered indices: production gidx values are bucket slots,
+    # not sequential — a sequential arm would let the gather coalesce
+    # into reads the real access pattern never gets.
+    gidx = ((jnp.arange(NR, dtype=jnp.int64) * 2654435761)
+            % M_ROWS).astype(jnp.int32)
+    ent = jnp.zeros((M_ROWS, 3), jnp.int64)
+
+    def g_cols(e, ix):
+        return (e[:, 0][ix] + e[:, 1][ix] + e[:, 2][ix]).sum()
+
+    def g_rows(e, ix):
+        return e[ix].sum()
+
+    def g_planes(e, ix):
+        p = dev._p32(e)  # [M, 3, 2]
+        acc = 0
+        for cdx in range(3):
+            for pl in range(2):
+                acc += p[:, cdx, pl][ix].astype(jnp.int64).sum()
+        return acc
+
+    timeit(f"old-entry gather: 3 col i64 ({NR} rows)",
+           jax.jit(g_cols), ent, gidx)
+    timeit("old-entry gather: row [N,3] i64", jax.jit(g_rows), ent, gidx)
+    timeit("old-entry gather: 6 plane i32", jax.jit(g_planes), ent, gidx)
+
+    bkt = (jnp.arange(NR, dtype=jnp.int64) * 2654435761) % (1 << 16)
+
+    def ranks_only(bb):
+        return dev._fifo_ranks(bb, jnp.ones(NR, bool), 1 << 16).sum()
+
+    timeit("fifo ranks (sort+cummax+unsort)", jax.jit(ranks_only), bkt)
+
+    wmv = jnp.full(1 << 16, dev.I64_MIN, jnp.int64)
+
+    def war_only(w, bb):
+        return dev._war_max64(
+            w, bb.astype(jnp.int32), jnp.arange(NR, dtype=jnp.int64),
+            jnp.ones(NR, bool),
+        ).sum()
+
+    timeit("war_max64 full width", jax.jit(war_only), wmv, bkt)
+
     # 9. chain scaling: is scan amortization working?
     for k in (1, 4, 18):
         st2 = dev.init_state(config)
