@@ -396,6 +396,11 @@ def _slot_war(slot, packed, active, n_slots: int):
 
 _LO_FLIP = jnp.int32(-0x80000000)  # sign-flip: u32 order as i32 order
 
+# Coarse gid-watermark granularity divisor: overstatement is bounded by
+# capacity / 2^_WM_COARSE_FRAC_BITS (see _war_max_gid_coarse and the
+# wm_shift derivation in ingest_step).
+_WM_COARSE_FRAC_BITS = 8
+
 
 def _war_max64(arr, idx, vals, ok):
     """``arr.at[idx[ok]].max(vals[ok])`` for an i64 WATERMARK array —
@@ -437,6 +442,37 @@ def _war_min64(arr, idx, vals, ok):
     complemented domain (an I64_MAX empty sentinel complements to
     _war_max64's I64_MIN one)."""
     return ~_war_max64(~arr, idx, ~jnp.asarray(vals, jnp.int64), ok)
+
+
+def _war_max_gid_coarse(arr, idx, gids, ok, shift: int):
+    """Conservative ``arr.at[idx[ok]].max(gids[ok])`` for a GID
+    watermark, in coarse 2^shift units: ONE i32 duplicate-index
+    scatter-max (vectorized) instead of _war_max64's two plane wars +
+    settled gather. Each contribution rounds UP to the next coarse
+    boundary, so the stored watermark OVERSTATES the true max displaced
+    gid by < 2^shift — against trust margins of >= ring capacity
+    (displaced entries are ring-laps old in steady state), callers pick
+    shift so the overstatement is a sub-percent slice of the margin.
+    Overstating a watermark costs scan fallbacks, never a wrong answer.
+    Untouched slots keep their exact i64 value (the i32 war runs on a
+    zeroed scratch; only slots it actually raised fold back), so empty
+    I64_MIN sentinels — and underfull-bucket trust before the first
+    wrap — survive bit-exact. gids are non-negative; the coarse domain
+    holds to 2^(31 + shift) spans of lifetime (2^45+ at bench shapes),
+    and gids past it SATURATE to the domain ceiling — the watermark
+    pins high and the gates stay conservatively closed, never silently
+    re-open (an unclamped int32 cast would wrap negative and freeze
+    the watermark instead)."""
+    n = arr.shape[0]
+    val32 = jnp.minimum(
+        (jnp.asarray(gids, jnp.int64) >> shift) + 1,
+        jnp.int64(0x7FFFFFFF),
+    ).astype(jnp.int32)
+    safe = jnp.where(ok, idx.astype(jnp.int32), n)
+    tmp = jnp.zeros(n + 1, jnp.int32).at[safe].max(
+        jnp.where(ok, val32, 0), mode="drop")[:n]
+    upd = jnp.where(tmp > 0, tmp.astype(jnp.int64) << shift, I64_MIN)
+    return jnp.maximum(arr, upd)
 
 
 def _ring(n, dtype, fill=0):
@@ -1127,7 +1163,8 @@ def _fifo_ranks(bucket, valid, n_buckets: int):
 
 
 def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
-                 depth, gid, verify, ts, valid, keyed_from: int):
+                 depth, gid, verify, ts, valid, keyed_from: int,
+                 wm_shift: int = 0):
     """ONE combined append of (gid, verify, ts) rows into the unified
     candidate-family entry array: ``gbucket`` is the global bucket id
     (addressing pos/wm), ``slot0`` the bucket's first entry row, and
@@ -1266,13 +1303,15 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     dslot = jnp.full(k48d.shape, T, jnp.int32)
     for i in range(_KEY_PROBES - 1, -1, -1):
         dslot = jnp.where(dhit[i], dslots3[i], dslot)
-    key_wm = _war_max64(key_wm, dslot, disp_gid,
-                        disp_ok & dhit.any(0))
+    # Coarse-ceil gid war (same trust margin as the bucket gates).
+    key_wm = _war_max_gid_coarse(key_wm, dslot, disp_gid,
+                                 disp_ok & dhit.any(0), wm_shift)
     n_drops = (v_s & ~placed).sum().astype(jnp.int64)
     return entries, pos, wm, key_tab, key_wm, n_drops
 
 
-def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
+def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid,
+                     wm_shift: int = 0):
     """Combined gid-only variant for the trace-membership sub-families;
     ``wm`` tracks the max gid ever displaced. Ring overwrite order is
     oldest-first, so once wm < (ring write_pos - ring capacity),
@@ -1306,7 +1345,13 @@ def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid):
     gid = jnp.asarray(gid, jnp.int64)
     old_gid = entries[jnp.where(keep, slot, 0)]
     wmv = jnp.where(occupied, old_gid, gid)
-    wm = _war_max64(wm, oob_b, wmv, occupied | (valid & ~keep))
+    # Coarse-ceil gid war (one vectorized i32 scatter-max; see
+    # _war_max_gid_coarse): overstates by < 2^wm_shift against the
+    # gate's ONE-ring margin (trust iff wm < write_pos - capacity;
+    # the 4x figure elsewhere in this docstring is bucket-coverage
+    # sizing, not gate slack).
+    wm = _war_max_gid_coarse(wm, oob_b, wmv,
+                             occupied | (valid & ~keep), wm_shift)
     entries = _uset(entries, slot, gid, keep)
     pos = pos + cnt.astype(pos.dtype)
     return entries, pos, wm
@@ -1758,6 +1803,14 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     n_key_drops = jnp.int64(0)
     if c.use_index:
         lay, _, _ = c.cand_layout
+        # Coarse-war granularity for ALL the gid watermarks in this
+        # step (ann_poison, key_wm, tr_wm): overstate by at most
+        # capacity / 2^_WM_COARSE_FRAC_BITS — a sub-percent slice of
+        # each gate's >= 1-ring trust margin (gates trust iff
+        # wm < write_pos - capacity, and displaced entries are
+        # ring-laps old whenever a gate is consulted in steady state).
+        wm_shift = max(0, c.capacity.bit_length() - 1
+                       - _WM_COARSE_FRAC_BITS)
         a_host = b.ann_service_id
         a_idx_ok = mask_a & (a_host >= 0) & (a_host < S)
         gid_a = jnp.where(a_idx_ok, span_gid_of_ann, -1)
@@ -1813,8 +1866,8 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         # annotation fast paths until the span is evicted (see
         # StoreState.ann_poison).
         mid = a_idx_ok & (a_host != h1) & (a_host != h2)
-        upd["ann_poison"] = _war_max64(
-            state.ann_poison, a_host, span_gid_of_ann, mid
+        upd["ann_poison"] = _war_max_gid_coarse(
+            state.ann_poison, a_host, span_gid_of_ann, mid, wm_shift
         )
         v_ok = (
             mask_a & (b.ann_value_id >= FIRST_USER_ANNOTATION_ID)
@@ -1865,6 +1918,7 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
             state.cand_idx, state.cand_pos, state.cand_wm,
             state.key_tab, state.key_wm, *cat,
             keyed_from=segments[0][1][0].shape[0],
+            wm_shift=wm_shift,
         )
         # Trace-membership family: row gids bucketed by trace-id hash,
         # one sub-family per ring (whole-trace fetch + durations).
@@ -1889,7 +1943,8 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
                  mask_b),
         )]
         upd["tr_idx"], upd["tr_pos"], upd["tr_wm"] = _gid_index_write(
-            state.tr_idx, state.tr_pos, state.tr_wm, *tcat
+            state.tr_idx, state.tr_pos, state.tr_wm, *tcat,
+            wm_shift=wm_shift,
         )
 
     # -- per-service latency histogram ---------------------------------
