@@ -100,9 +100,11 @@ class TestRoutes:
         assert status == 404
 
     def test_quantiles_route(self, app):
+        # params arrive as SCALAR strings (dict(parse_qsl(...)) in the
+        # HTTP layer), not lists.
         status, body = app.handle(
             "GET", "/api/quantiles",
-            {"serviceName": "api", "q": ["0.5,0.99"]},
+            {"serviceName": "api", "q": "0.5,0.99"},
         )
         assert status == 200
         assert body["quantiles"] == [0.5, 0.99]
@@ -232,6 +234,31 @@ class TestSelfTracing:
         spans = store.get_spans_by_trace_id(0xABCD1234)
         assert spans and spans[0].id == 0x1111
         assert spans[0].parent_id == 0x2222
+
+    def test_response_echoes_trace_id(self):
+        """Self-traced API responses echo X-B3-TraceId/-SpanId with
+        exactly the ids the recorded span carries — the devtools
+        extension's contract (web/extension/)."""
+        store, collector, api = self._app()
+        resp_headers: list = []
+        api.handle("GET", "/api/services", {},
+                   headers={"X-B3-TraceId": "beef", "X-B3-SpanId": "77"},
+                   response_headers=resp_headers)
+        hdr = dict(resp_headers)
+        assert hdr["X-B3-TraceId"] == "beef"
+        assert hdr["X-B3-SpanId"] == "77"
+        # Fresh trace: the echoed id is queryable afterwards.
+        resp_headers = []
+        api.handle("GET", "/api/services", {},
+                   response_headers=resp_headers)
+        tid = int(dict(resp_headers)["X-B3-TraceId"], 16)
+        collector.flush()
+        assert store.get_spans_by_trace_id(tid)
+        # Ingest doors stay untraced AND unheadered.
+        resp_headers = []
+        api.handle("POST", "/api/spans", {}, b"[]",
+                   response_headers=resp_headers)
+        assert not dict(resp_headers).get("X-B3-TraceId")
 
     def test_ingest_doors_not_traced(self):
         store, collector, api = self._app()

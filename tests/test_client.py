@@ -101,3 +101,39 @@ class TestWSGIMiddleware:
         assert tags["http.uri"] == "/hello"
         assert s.service_name == "front"
         collector.close()
+
+    def test_response_echoes_b3_headers(self):
+        """The response carries X-B3-TraceId/-SpanId matching the span
+        actually recorded — the contract the devtools extension
+        (web/extension/) and any caller correlating responses to
+        traces relies on."""
+        store = InMemorySpanStore()
+        collector = Collector(store)
+        tracer = Tracer("front", collector.accept, rng=random.Random(7))
+        app = ZipkinWSGIMiddleware(self.make_app(), tracer)
+        captured = {}
+
+        def start_response(status, headers, exc_info=None):
+            captured["headers"] = dict(headers)
+
+        # Continued trace: echoed ids == the incoming ids.
+        app({"PATH_INFO": "/x", "REQUEST_METHOD": "GET",
+             "HTTP_X_B3_TRACEID": "ab", "HTTP_X_B3_SPANID": "cd",
+             "HTTP_X_B3_SAMPLED": "1"}, start_response)
+        assert captured["headers"]["X-B3-TraceId"] == "ab"
+        assert captured["headers"]["X-B3-SpanId"] == "cd"
+        assert captured["headers"]["X-B3-Sampled"] == "1"
+        # Fresh trace: echoed id is the one the recorded span carries.
+        app({"PATH_INFO": "/y", "REQUEST_METHOD": "GET"},
+            start_response)
+        tid = int(captured["headers"]["X-B3-TraceId"], 16)
+        collector.flush()
+        spans = store.get_spans_by_trace_id(tid)
+        assert [s.name for s in spans if s.name == "get /y"]
+        # Unsampled: NO trace id echoed (it would be a dead link for
+        # the extension) — only the sampled=0 marker.
+        app({"PATH_INFO": "/z", "REQUEST_METHOD": "GET",
+             "HTTP_X_B3_SAMPLED": "0"}, start_response)
+        assert "X-B3-TraceId" not in captured["headers"]
+        assert captured["headers"]["X-B3-Sampled"] == "0"
+        collector.close()

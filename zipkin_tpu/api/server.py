@@ -143,7 +143,8 @@ class ApiServer:
                                                   "/api/v1/spans"))
 
     def handle(self, method: str, path: str, params: dict,
-               body: bytes = b"", headers: Optional[dict] = None
+               body: bytes = b"", headers: Optional[dict] = None,
+               response_headers: Optional[list] = None
                ) -> Tuple[int, object]:
         if not self._should_self_trace(method, path):
             return self._dispatch(method, path, params, body)
@@ -152,6 +153,14 @@ class ApiServer:
         from zipkin_tpu.client import B3Headers
 
         b3 = B3Headers.parse(headers or {})
+        # Resolve ids up front so the response can echo X-B3-TraceId
+        # (the devtools extension's signal, web/extension/) with
+        # exactly the ids the recorded span carries — the one contract
+        # site is Tracer.resolve (unsampled requests echo only
+        # X-B3-Sampled: 0, never a dead trace link).
+        resolved = self.tracer.resolve(b3)
+        if response_headers is not None:
+            response_headers.extend(resolved.emit().items())
         start_us = int(_time.time() * 1e6)
         status = 500
         try:
@@ -159,7 +168,7 @@ class ApiServer:
             return status, payload
         finally:
             self.tracer.server_span(
-                f"{method.lower()} {path}", b3,
+                f"{method.lower()} {path}", resolved,
                 start_us=start_us, end_us=int(_time.time() * 1e6),
                 tags={"http.uri": path, "http.method": method,
                       "http.status": str(status)},
@@ -204,7 +213,7 @@ class ApiServer:
                 _require(params, "serviceName"))
         if path == "/api/quantiles":
             qs = [float(x) for x in
-                  params.get("q", ["0.5,0.95,0.99"])[0].split(",")]
+                  params.get("q", "0.5,0.95,0.99").split(",")]
             vals = self.query.get_service_duration_quantiles(
                 _require(params, "serviceName"), qs)
             # An empty histogram yields NaNs, which json.dumps would
@@ -410,9 +419,11 @@ def make_server(api: ApiServer, host: str = "0.0.0.0", port: int = 9411
             params = dict(parse_qsl(parsed.query))
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
+            extra_headers: list = []
             status, payload = api.handle(
                 self.command, parsed.path, params, body,
                 headers=dict(self.headers),
+                response_headers=extra_headers,
             )
             if isinstance(payload, RawResponse):
                 ctype, data = payload.content_type, payload.body
@@ -422,6 +433,8 @@ def make_server(api: ApiServer, host: str = "0.0.0.0", port: int = 9411
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for name, value in extra_headers:
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(data)
 

@@ -106,6 +106,26 @@ class Tracer:
             return b3.sampled
         return self.rng.random() < self.sample_rate
 
+    def resolve(self, b3: B3Headers) -> B3Headers:
+        """Pin the ids and sampling decision for one server request —
+        THE single place the echo/record contract lives: the resolved
+        headers are what the response echoes (so the devtools
+        extension links real traces) and exactly what server_span
+        records. Unsampled requests resolve with ids=None: nothing
+        will be recorded, so echoing a trace id would hand out dead
+        links — only X-B3-Sampled: 0 is emitted for them."""
+        sampled = self.should_sample(b3)
+        if not sampled:
+            return B3Headers(sampled=False)
+        return B3Headers(
+            trace_id=(b3.trace_id if b3.trace_id is not None
+                      else _new_id(self.rng)),
+            span_id=(b3.span_id if b3.span_id is not None
+                     else _new_id(self.rng)),
+            parent_id=b3.parent_id,
+            sampled=True,
+        )
+
     def server_span(
         self, name: str, b3: B3Headers,
         start_us: Optional[int] = None, end_us: Optional[int] = None,
@@ -147,6 +167,15 @@ class ZipkinWSGIMiddleware:
             for k, v in environ.items() if k.startswith("HTTP_")
         }
         b3 = B3Headers.parse(headers)
+        # Resolve ids and the sampling decision UP FRONT so the
+        # response can echo X-B3-TraceId/-SpanId — the signal the
+        # browser-extension role watches to link the current page's
+        # trace into the UI (reference: zipkin-browser-extension's
+        # request observer; ours reads these echoed headers in a
+        # devtools panel, zipkin_tpu/web/extension/). The recorded
+        # span reuses exactly the echoed ids; unsampled requests echo
+        # only X-B3-Sampled: 0 (see Tracer.resolve).
+        resolved = self.tracer.resolve(b3)
         start_us = int(time.time() * 1e6)
         path = environ.get("PATH_INFO", "/")
         method = environ.get("REQUEST_METHOD", "GET")
@@ -154,6 +183,8 @@ class ZipkinWSGIMiddleware:
 
         def capture_start_response(status, resp_headers, exc_info=None):
             status_holder.append(status)
+            resp_headers = list(resp_headers) + list(
+                resolved.emit().items())
             return start_response(status, resp_headers, exc_info)
 
         try:
@@ -161,7 +192,7 @@ class ZipkinWSGIMiddleware:
         finally:
             self.tracer.server_span(
                 f"{method.lower()} {path}",
-                b3,
+                resolved,
                 start_us=start_us,
                 end_us=int(time.time() * 1e6),
                 tags={
