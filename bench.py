@@ -15,7 +15,10 @@ Configs (BASELINE.md / BASELINE.json):
   Plus the read path VERDICT cares about: get_trace_ids by service /
   span name / annotation / binary value, durations, and whole-trace
   materialization, each timed wall-clock through the public SpanStore
-  API (device kernel + host decode — what an API call pays).
+  API (device kernel + host decode — what an API call pays) — and the
+  batched-query phase (bench_batched_queries): k queries through one
+  get_trace_ids_multi launch vs k singular dispatches, the
+  dispatch-floor amortization the API's query coalescer rides.
 
 Span stream: one device-resident template batch, re-stamped ON DEVICE
 each step (trace/span/parent ids XOR a per-step salt — preserving the
@@ -468,6 +471,55 @@ def bench_tpu_queries(store, reps: int = 12):
     return out
 
 
+def bench_batched_queries(store, ks=(1, 4, 16, 64), reps: int = 5):
+    """The query dispatch-floor amortization (r6 read-side tentpole):
+    k concurrent API queries ride ONE ``get_trace_ids_multi`` launch
+    (the tier QueryService's cross-request coalescer feeds) instead of
+    k ~100 ms dispatches. Per k: wall-clock of k serial singular calls
+    vs one batched call, identity of the results, and the implied
+    aggregate queries/s — the scaling-with-batch-size evidence the
+    acceptance gate asks for (batched < 0.5 x serial at k >= 4 on
+    dispatch-floor-dominated hardware)."""
+    _log("batched-queries: starting")
+    state = store.state
+    end_ts = int(state.ts_max) + 1
+    S = store.config.max_services
+    rng = np.random.default_rng(23)
+    out = {}
+    for k in ks:
+        svcs = [f"svc-{i:04d}" for i in rng.integers(0, S, size=k)]
+        queries = [("name", s, None, end_ts, 10) for s in svcs]
+
+        def serial():
+            return [store.get_trace_ids_by_name(s, None, end_ts, 10)
+                    for s in svcs]
+
+        def batched():
+            return store.get_trace_ids_multi(queries)
+
+        t_serial = _timeit(serial, reps=reps, warmup=1)
+        t_batched = _timeit(batched, reps=reps, warmup=1)
+        identical = [
+            [(i.trace_id, i.timestamp) for i in ids] for ids in serial()
+        ] == [
+            [(i.trace_id, i.timestamp) for i in ids] for ids in batched()
+        ]
+        ratio = (t_batched["p50_ms"] / t_serial["p50_ms"]
+                 if t_serial["p50_ms"] else 0.0)
+        out[f"k{k}"] = {
+            "serial": t_serial, "batched": t_batched,
+            "batched_over_serial_p50": round(ratio, 3),
+            "batched_queries_per_s": round(
+                k / (t_batched["p50_ms"] / 1e3), 1
+            ) if t_batched["p50_ms"] else 0.0,
+            "identical": identical,
+        }
+        _log(f"batched-queries: k={k} serial p50 "
+             f"{t_serial['p50_ms']:.1f}ms batched p50 "
+             f"{t_batched['p50_ms']:.1f}ms identical={identical}")
+    return out
+
+
 def bench_exactness(store, n_queries: int = 24,
                     budget_s: float | None = None):
     """On-device index-vs-scan exactness (VERDICT r3 item 7): the same
@@ -863,6 +915,11 @@ def main():
             store, reps=5 if args.smoke else 12
         )
         emit("stream+queries")
+        detail["batched_queries"] = bench_batched_queries(
+            store, ks=(1, 4, 16) if args.smoke else (1, 4, 16, 64),
+            reps=3 if args.smoke else 5,
+        )
+        emit("stream+queries+batched")
         detail["index_exactness"] = bench_exactness(
             store, n_queries=9 if args.smoke else 24,
             budget_s=None if args.smoke else args.exactness_budget,

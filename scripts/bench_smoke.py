@@ -1,0 +1,153 @@
+"""CPU-runnable smoke bench: one JSON line of perf-structure evidence.
+
+Three things the full bench (bench.py) can only prove on real hardware
+are provable structurally on any backend, every CI run:
+
+1. **Fused-ingest timing** at small N — a regression canary, not a
+   throughput claim (CPU ms/step moves with the machine; the JSON
+   carries it for trending).
+2. **Index-family op counts** — the whole r5→r6 tentpole is "fewer
+   scatter/gather launches per ingest step" (the unified index arena:
+   one rank-sort + one entry scatter block + ONE shared watermark
+   scatter for all seven families). Per-kernel overhead dominates on
+   the target device class (NOTES_r03 §3), so the SCATTER COUNT of the
+   compiled step is the portable proxy for the TPU win, and the tier-1
+   lane asserts it doesn't creep back up (tests/test_bench_smoke.py).
+3. **Batched-query scaling** — k queries through one
+   ``get_trace_ids_multi`` launch vs k singular calls; the read-path
+   dispatch-floor amortization the query coalescer rides on.
+
+Usage:  python scripts/bench_smoke.py [--spans 7000] [--k 8]
+Emits exactly one JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _count_ops(stablehlo_text: str) -> dict:
+    """Scatter/gather/sort census of a jitted function's StableHLO
+    lowering — backend-INDEPENDENT (the CPU backend fuses scatters out
+    of its optimized HLO, so compiled-module counts aren't portable),
+    and exactly the structural quantity the unified-arena work drove
+    down: how many scatter/sort ops the ingest step ISSUES per batch.
+    r5 split-design baseline at the smoke shapes: 101 scatters /
+    6 sorts / 80 gathers; the r6 unified arena ships 95 / 5 / 79 (and
+    moves the exact candidate-ts watermark war behind a lax.cond that
+    real traffic never executes)."""
+    import re
+
+    return {
+        op: len(re.findall(rf'"stablehlo\.{op}"', stablehlo_text))
+        for op in ("scatter", "gather", "sort")
+    }
+
+
+def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
+    import numpy as np  # noqa: F401  (kept: smoke envs import-check it)
+
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import ColumnarTraceGen
+
+    config = dev.StoreConfig(
+        capacity=1 << 12, ann_capacity=1 << 13, bann_capacity=1 << 12,
+        max_services=64, max_span_names=128, max_annotation_values=512,
+        max_binary_keys=128, cms_width=1 << 12, hll_p=8,
+        quantile_buckets=512,
+    )
+    store = TpuSpanStore(config)
+    gen = ColumnarTraceGen(store.dicts, n_services=32, n_span_names=64,
+                           spans_per_trace=7)
+    batch_traces = 64
+    pad_s, pad_a, pad_b = 512, 1024, 512
+    dbs = []
+    n_batches = max(1, total_spans // (batch_traces * 7))
+    for _ in range(n_batches):
+        batch, name_lc, indexable = gen.next_batch(batch_traces)
+        dbs.append(dev.make_device_batch(
+            batch, name_lc, indexable,
+            pad_spans=pad_s, pad_anns=pad_a, pad_banns=pad_b,
+        ))
+
+    # Op-count census of the fused step's lowering (the compile below
+    # shares the jit cache, so this adds a trace, not a compile).
+    state = store.state
+    ops = _count_ops(dev.ingest_step.lower(state, dbs[0]).as_text())
+
+    # Fused-ingest timing (compile excluded: first step warms). The
+    # warm-up step's spans are excluded from the rate — spans_seen is
+    # snapshotted before t0 so the numerator matches the timed window.
+    state = dev.ingest_step(state, dbs[0])
+    import jax
+
+    warm = int(jax.device_get(state.counters["spans_seen"]))
+    t0 = time.perf_counter()
+    for db in dbs:
+        state = dev.ingest_step(state, db)
+    seen = int(jax.device_get(state.counters["spans_seen"]))
+    dt = time.perf_counter() - t0
+    total = seen - warm
+    store.adopt_state(state, spans_written=seen)
+
+    # Batched-query scaling: k singular launches vs one multi launch.
+    end_ts = int(jax.device_get(state.ts_max)) + 1
+    svcs = sorted(store.get_all_service_names())
+    queries = [
+        ("name", svcs[i % len(svcs)], None, end_ts, 10)
+        for i in range(k_queries)
+    ]
+
+    def serial():
+        return [store.get_trace_ids_by_name(q[1], q[2], q[3], q[4])
+                for q in queries]
+
+    def batched():
+        return store.get_trace_ids_multi(queries)
+
+    serial(), batched()  # warm both paths' compile caches
+    t0 = time.perf_counter()
+    want = serial()
+    serial_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got = batched()
+    batched_s = time.perf_counter() - t0
+    identical = [
+        [(i.trace_id, i.timestamp) for i in ids] for ids in got
+    ] == [
+        [(i.trace_id, i.timestamp) for i in ids] for ids in want
+    ]
+
+    return {
+        "metric": "bench_smoke",
+        "spans": total,
+        "ingest_spans_per_s": round(total / dt, 1),
+        "ingest_ms_per_batch": round(dt / len(dbs) * 1e3, 2),
+        "step_scatters": ops["scatter"],
+        "step_gathers": ops["gather"],
+        "step_sorts": ops["sort"],
+        "multi_query": {
+            "k": k_queries,
+            "serial_ms": round(serial_s * 1e3, 2),
+            "batched_ms": round(batched_s * 1e3, 2),
+            "speedup": round(serial_s / batched_s, 2) if batched_s else 0,
+            "identical": identical,
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spans", type=int, default=7000)
+    ap.add_argument("--k", type=int, default=8)
+    args = ap.parse_args()
+    print(json.dumps(run(args.spans, args.k)), flush=True)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    main()

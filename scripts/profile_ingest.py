@@ -135,7 +135,7 @@ def main():
     )
 
     def cand_only(st, bb):
-        lay, _, _ = c.cand_layout
+        lay, _, _ = c.idx_layout
         a_host = bb.ann_service_id
         a_idx_ok = mask_a & (a_host >= 0) & (a_host < S)
         span_gid_of_ann = st.write_pos + bb.ann_span_idx.astype(jnp.int64)
@@ -205,46 +205,37 @@ def main():
         fams = [f for f, _ in segments]
         assert (fams[0] == StoreConfig.CAND_SVC
                 and StoreConfig.CAND_SVC not in fams[1:]), fams
+        n_cand_rows = sum(p[0].shape[0] for _, p in segments)
+        # Trace-membership segments trail in the SAME unified pass (the
+        # r6 arena merge): one rank sort + scatter block for all seven
+        # families — this arm now measures the whole index write.
+        tb = _bucket_of(_mixb([bb.trace_id]), c.trace_buckets)
+        tmix = _verify_of(_mixb([bb.trace_id]))
+        gids = st.write_pos + jnp.arange(P, dtype=jnp.int64)
+        a_gids = st.ann_write_pos + jnp.arange(PA, dtype=jnp.int64)
+        bb_gids = st.bann_write_pos + jnp.arange(PB, dtype=jnp.int64)
+        NC = StoreConfig.N_CAND_FAMILIES
+        segments.append(seg(NC + StoreConfig.TR_SPAN, tb, gids, tmix,
+                            bb.ts_last, mask))
+        segments.append(seg(NC + StoreConfig.TR_ANN, tb[bb.ann_span_idx],
+                            a_gids, tmix[bb.ann_span_idx], ts_a, mask_a))
+        segments.append(seg(NC + StoreConfig.TR_BANN,
+                            tb[bb.bann_span_idx], bb_gids,
+                            tmix[bb.bann_span_idx], ts_b, mask_b))
         cat = [jnp.concatenate(parts)
                for parts in zip(*(p for _, p in segments))]
         out = dev._index_write(
             st.cand_idx, st.cand_pos, st.cand_wm, st.key_tab, st.key_wm,
-            *cat, keyed_from=segments[0][1][0].shape[0]
+            st.ann_poison, *cat,
+            keyed_from=segments[0][1][0].shape[0],
+            n_cand_rows=n_cand_rows, n_cand_buckets=c.cand_layout[1],
+            poison_bucket=a_host, poison_gid=span_gid_of_ann,
+            poison_ok=a_idx_ok & (a_host != h1) & (a_host != h2),
         )
         return out[0].sum()
 
-    timeit("candidate index write (concat+sort+scatter)",
+    timeit("unified index write (cand+trace, concat+sort+scatter)",
            jax.jit(cand_only), state, b)
-
-    # 6. trace-membership gid index write
-    def tr_only(st, bb):
-        tlay, _, _ = c.trace_layout
-        tb = _bucket_of(_mixb([bb.trace_id]), c.trace_buckets)
-        gids = st.write_pos + jnp.arange(P, dtype=jnp.int64)
-        a_gids = st.ann_write_pos + jnp.arange(PA, dtype=jnp.int64)
-        bb_gids = st.bann_write_pos + jnp.arange(PB, dtype=jnp.int64)
-
-        def tseg(fam, local_bucket, gid, ok):
-            b_base, s_base, n_b, depth = tlay[fam]
-            lb = jnp.clip(local_bucket, 0, n_b - 1)
-            return (
-                lb.astype(jnp.int32) + jnp.int32(b_base),
-                lb.astype(jnp.int64) * depth + jnp.int64(s_base),
-                jnp.full(lb.shape[0], depth, jnp.int32),
-                jnp.asarray(gid, jnp.int64),
-                ok,
-            )
-
-        tcat = [jnp.concatenate(parts) for parts in zip(
-            tseg(StoreConfig.TR_SPAN, tb, gids, mask),
-            tseg(StoreConfig.TR_ANN, tb[bb.ann_span_idx], a_gids, mask_a),
-            tseg(StoreConfig.TR_BANN, tb[bb.bann_span_idx], bb_gids,
-                 mask_b),
-        )]
-        out = dev._gid_index_write(st.tr_idx, st.tr_pos, st.tr_wm, *tcat)
-        return out[0].sum()
-
-    timeit("trace gid index write", jax.jit(tr_only), state, b)
 
     # 7. histogram/counter scatter-adds
     def hist_only(st, bb):

@@ -58,6 +58,8 @@ _SLOW_TESTS = {
     "test_trace_membership_after_eviction",
     "test_trace_membership_fast_path_matches_scan",
     "test_wrapped_bucket_falls_back_to_scan",
+    "test_far_future_timestamps_stay_exact",
+    "test_ts_watermark_coarse_boundary_window_stays_exact",
     "test_sharded_dep_links_survive_eviction",
     "test_sharded_dep_moments_match_single_store",
     "test_sharded_dictionary_overflow_service_routes_to_scan",

@@ -318,3 +318,125 @@ class TestTimelineComboRoutes:
         assert status == 200
         assert body["binaryAnnotations"]
         assert body["binaryAnnotations"][0]["key"]
+
+
+def _strict_json_roundtrip(payload):
+    """Round-trip a handler payload through a STRICT JSON parser:
+    json.dumps happily emits the bare tokens Infinity/-Infinity/NaN
+    (python floats), which json.loads ALSO accepts by default — but no
+    browser's JSON.parse does. parse_constant firing means the route
+    shipped invalid JSON (the /api/dependencies Infinity bug)."""
+
+    def boom(name):
+        raise AssertionError(f"route emitted non-JSON constant {name!r}")
+
+    return json.loads(json.dumps(payload), parse_constant=boom)
+
+
+class TestStrictJsonEveryRoute:
+    """Every API route's body must parse under a strict JSON reader —
+    on an EMPTY store (monoid zeros: the Dependencies Time.Top/Bottom
+    infinities, NaN quantiles) and on a seeded one."""
+
+    # (method, path, params, body) — every JSON route the server maps.
+    ROUTES = [
+        ("GET", "/health", {}, b""),
+        ("GET", "/metrics", {}, b""),
+        ("GET", "/api/services", {}, b""),
+        ("GET", "/api/spans", {"serviceName": "api"}, b""),
+        ("GET", "/api/top_annotations", {"serviceName": "api"}, b""),
+        ("GET", "/api/top_kv_annotations", {"serviceName": "api"}, b""),
+        ("GET", "/api/quantiles", {"serviceName": "api"}, b""),
+        ("GET", "/api/dependencies", {}, b""),
+        ("GET", "/api/dependencies/0/100", {}, b""),
+        ("GET", "/api/traces_exist", {"traceIds": "1,2,deadbeef"}, b""),
+        ("GET", "/api/query", {"serviceName": "api"}, b""),
+        ("GET", "/api/trace/1", {}, b""),
+        ("GET", "/api/timeline/1", {}, b""),
+        ("GET", "/api/combo/1", {}, b""),
+        ("GET", "/api/is_pinned/1", {}, b""),
+        ("GET", "/vars/sampleRate", {}, b""),
+        ("POST", "/vars/sampleRate", {}, b"1.0"),
+        ("POST", "/api/pin/1/true", {}, b""),
+        ("POST", "/api/pin/1/false", {}, b""),
+        ("POST", "/api/spans", {}, b"[]"),
+        ("POST", "/scribe", {}, b"[]"),
+    ]
+
+    def _drive(self, api):
+        from zipkin_tpu.api.server import RawResponse
+
+        for method, path, params, body in self.ROUTES:
+            status, payload = api.handle(method, path, params, body)
+            assert not isinstance(payload, RawResponse), path
+            _strict_json_roundtrip(payload)  # raises on Infinity/NaN
+
+    def test_empty_store_strict_json(self):
+        store = InMemorySpanStore()
+        api = ApiServer(QueryService(store), Collector(store),
+                        self_trace=False)
+        self._drive(api)
+
+    def test_seeded_store_strict_json(self, app):
+        self._drive(app)
+
+    def test_empty_dependencies_infinity_regression(self):
+        """The Dependencies monoid zero is (+inf, -inf); the route must
+        serialize that as null, never the invalid bare Infinity."""
+        store = InMemorySpanStore()
+        api = ApiServer(QueryService(store), self_trace=False)
+        status, body = api.handle("GET", "/api/dependencies", {})
+        assert status == 200
+        assert body["startTime"] is None and body["endTime"] is None
+        _strict_json_roundtrip(body)
+
+
+class TestTracesExistRoute:
+    """tracesExist (zipkinQuery.thrift:154) over HTTP, per backend."""
+
+    def test_memory_store(self, app):
+        status, body = app.handle(
+            "GET", "/api/traces_exist", {"traceIds": "1,2,deadbeef"})
+        assert status == 200
+        assert body == {"exist": ["1", "2"]}
+
+    def test_requires_ids(self, app):
+        assert app.handle("GET", "/api/traces_exist", {})[0] == 400
+
+    def test_negative_id_hex_form(self):
+        store = InMemorySpanStore()
+        api = ApiServer(QueryService(store), self_trace=False)
+        ep = Endpoint(1, 80, "neg")
+        store.apply([Span(-123, "op", 1, None,
+                          (Annotation(5, "sr", ep),), ())])
+        status, body = api.handle(
+            "GET", "/api/traces_exist",
+            {"traceIds": "ffffffffffffff85,42"})
+        assert status == 200 and body == {"exist": ["ffffffffffffff85"]}
+
+    def test_sql_store(self, tmp_path):
+        from zipkin_tpu.store.sql import SqliteSpanStore
+
+        store = SqliteSpanStore()
+        api = ApiServer(QueryService(store), self_trace=False)
+        store.apply([rpc(7, 10, None, 100, 200)])
+        status, body = api.handle(
+            "GET", "/api/traces_exist", {"traceIds": "7,8"})
+        assert status == 200 and body == {"exist": ["7"]}
+        store.close()
+
+    def test_tpu_store(self):
+        from zipkin_tpu.store.device import StoreConfig
+        from zipkin_tpu.store.tpu import TpuSpanStore
+
+        store = TpuSpanStore(StoreConfig(
+            capacity=256, ann_capacity=1024, bann_capacity=512,
+            max_services=16, max_span_names=32,
+            max_annotation_values=64, max_binary_keys=16,
+            cms_width=256, hll_p=6, quantile_buckets=128,
+        ))
+        api = ApiServer(QueryService(store), self_trace=False)
+        store.apply([rpc(9, 10, None, 100, 200)])
+        status, body = api.handle(
+            "GET", "/api/traces_exist", {"traceIds": "9,a"})
+        assert status == 200 and body == {"exist": ["9"]}

@@ -1,0 +1,41 @@
+"""Tier-1 perf-structure gate (scripts/bench_smoke.py): the compiled
+ingest step's scatter/sort op counts must not regress.
+
+Per-kernel overhead dominates the target device class (NOTES_r03 §3);
+the r6 unified index arena exists to cut scatter/sort launches per
+batch. These ceilings are the measured post-merge counts at the smoke
+shapes — if a change pushes past them, it re-grew the very block the
+tentpole collapsed (raise them only with a NOTES entry explaining what
+bought the extra launches). r5 split-design baseline: 101 scatters /
+6 sorts.
+"""
+
+import json
+import subprocess
+import sys
+
+# Measured at the bench_smoke shapes on the unified-arena step
+# (StableHLO census, backend-independent). The r5 split design sat at
+# 101/6/80.
+MAX_STEP_SCATTERS = 95
+MAX_STEP_SORTS = 5
+
+
+def test_bench_smoke_json_and_op_ceilings():
+    proc = subprocess.run(
+        [sys.executable, "scripts/bench_smoke.py", "--spans", "2000",
+         "--k", "4"],
+        capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    rec = json.loads(line)  # exactly one JSON line
+    assert rec["metric"] == "bench_smoke"
+    assert rec["spans"] > 0 and rec["ingest_spans_per_s"] > 0
+    # The index-family step-count gate.
+    assert rec["step_scatters"] <= MAX_STEP_SCATTERS, rec
+    assert rec["step_sorts"] <= MAX_STEP_SORTS, rec
+    # Batched-query phase ran and agreed with serial execution.
+    mq = rec["multi_query"]
+    assert mq["k"] == 4 and mq["identical"] is True
+    assert mq["serial_ms"] > 0 and mq["batched_ms"] > 0

@@ -137,3 +137,49 @@ class TestWSGIMiddleware:
         assert "X-B3-TraceId" not in captured["headers"]
         assert captured["headers"]["X-B3-Sampled"] == "0"
         collector.close()
+
+
+class TestNestedMiddlewares:
+    def test_nested_middleware_emits_single_b3_header_set(self):
+        """Two stacked ZipkinWSGIMiddlewares (an app composed of traced
+        sub-apps) must not emit duplicate/conflicting X-B3-* response
+        headers: the OUTER middleware resolved the request's ids, so
+        its echo wins and pre-existing X-B3-* entries are filtered
+        case-insensitively (ADVICE r5 — the devtools panel links
+        whichever header it reads first)."""
+        import random
+
+        from zipkin_tpu.client import Tracer, ZipkinWSGIMiddleware
+
+        def app(environ, start_response):
+            # An app that already emitted its own (conflicting) B3
+            # echo, lowercase to exercise case-insensitive filtering.
+            start_response("200 OK", [
+                ("Content-Type", "text/plain"),
+                ("x-b3-traceid", "dead"),
+                ("X-B3-SpanId", "beef"),
+            ])
+            return [b"ok"]
+
+        inner = ZipkinWSGIMiddleware(
+            app, Tracer("inner", lambda spans: None,
+                        rng=random.Random(1)))
+        outer = ZipkinWSGIMiddleware(
+            inner, Tracer("outer", lambda spans: None,
+                          rng=random.Random(2)))
+        captured = {}
+
+        def start_response(status, headers, exc_info=None):
+            captured["headers"] = headers
+
+        outer({"PATH_INFO": "/n", "REQUEST_METHOD": "GET",
+               "HTTP_X_B3_TRACEID": "ab", "HTTP_X_B3_SPANID": "cd",
+               "HTTP_X_B3_SAMPLED": "1"}, start_response)
+        names = [k.lower() for k, _ in captured["headers"]
+                 if k.lower().startswith("x-b3-")]
+        # Exactly one value per B3 header, no duplicates.
+        assert sorted(names) == sorted(set(names))
+        by_name = {k.lower(): v for k, v in captured["headers"]}
+        assert by_name["x-b3-traceid"] == "ab"
+        assert by_name["x-b3-spanid"] == "cd"
+        assert by_name["x-b3-sampled"] == "1"

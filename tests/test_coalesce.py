@@ -1,0 +1,150 @@
+"""Cross-request query coalescing (query/coalesce.py): concurrent API
+queries share one device launch, with results identical to serial
+execution — correctness on the 8-device CPU mesh (conftest) and a
+bitwise batched-vs-unbatched determinism check.
+"""
+
+import threading
+
+from zipkin_tpu.query.coalesce import QueryCoalescer
+from zipkin_tpu.query.request import QueryRequest
+from zipkin_tpu.query.service import QueryService
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.tracegen import generate_traces
+
+SPANS = [s for t in generate_traces(n_traces=30, max_depth=4,
+                                    n_services=6) for s in t]
+END_TS = max(s.last_timestamp for s in SPANS if s.last_timestamp) + 1
+
+
+def _store():
+    st = TpuSpanStore(StoreConfig(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=64, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=256,
+    ))
+    st.apply(SPANS)
+    return st
+
+
+def _ids(res):
+    return [(i.trace_id, i.timestamp) for i in res]
+
+
+def test_concurrent_requests_share_launch_and_match_serial():
+    """N threads fire getTraceIds simultaneously; the coalescer must
+    batch at least some of them into one get_trace_ids_multi launch,
+    and every caller must receive exactly its serial answer."""
+    store = _store()
+    svc = QueryService(store, coalesce_window_s=0.2)
+    svcs = sorted(store.get_all_service_names())
+    reqs = [
+        QueryRequest(service_name=svcs[i % len(svcs)], end_ts=END_TS,
+                     limit=10)
+        for i in range(12)
+    ]
+    want = [
+        _ids(store.get_trace_ids_by_name(r.service_name, None, r.end_ts,
+                                         r.limit))
+        for r in reqs
+    ]
+    results = [None] * len(reqs)
+    errors = []
+    barrier = threading.Barrier(len(reqs))
+
+    def call(i):
+        try:
+            barrier.wait()
+            resp = svc.get_trace_ids(reqs[i])
+            results[i] = list(resp.trace_ids)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    for i, r in enumerate(reqs):
+        assert results[i] == [tid for tid, _ in want[i]], r.service_name
+    # The dispatch-floor claim: fewer launches than callers.
+    assert svc.coalescer.queries == len(reqs)
+    assert svc.coalescer.launches_saved >= 1
+    assert svc.coalescer.batches + svc.coalescer.launches_saved == len(reqs)
+
+
+def test_batched_and_unbatched_paths_bitwise_identical():
+    """The determinism contract: the same query list through ONE
+    get_trace_ids_multi launch and through the singular per-query paths
+    must produce bitwise-identical (trace id, timestamp) sets."""
+    store = _store()
+    queries = []
+    for s in sorted(store.get_all_service_names()):
+        queries.append(("name", s, None, END_TS, 10))
+        queries.append(
+            ("annotation", s, "some custom annotation", None, END_TS, 10))
+        queries.append(
+            ("annotation", s, "http.uri", b"/api/widgets", END_TS, 10))
+    batched = store.get_trace_ids_multi(queries)
+    for q, got in zip(queries, batched):
+        if q[0] == "name":
+            want = store.get_trace_ids_by_name(*q[1:])
+        else:
+            want = store.get_trace_ids_by_annotation(*q[1:])
+        assert _ids(got) == _ids(want), q
+    # And through the coalescer itself (single caller, window 0).
+    coal = QueryCoalescer(store, window_s=0.0)
+    again = coal.run(queries)
+    assert [_ids(r) for r in again] == [_ids(r) for r in batched]
+
+
+def test_coalescer_propagates_errors_to_every_caller():
+    class Boom:
+        def get_trace_ids_multi(self, queries):
+            raise RuntimeError("device gone")
+
+    coal = QueryCoalescer(Boom(), window_s=0.05)
+    errs = []
+    barrier = threading.Barrier(3)
+
+    def call():
+        try:
+            barrier.wait()
+            coal.run([("name", "svc", None, 10, 10)])
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    threads = [threading.Thread(target=call) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert errs == ["device gone"] * 3
+
+
+def test_multi_slice_request_rides_one_launch_per_round():
+    """A multi-slice getTraceIds (span name + annotation) resolves both
+    probe and aligned rounds through the batched path, and matches the
+    slice-by-slice singular results intersected by hand."""
+    store = _store()
+    svc = QueryService(store, coalesce_window_s=0.0)
+    service = sorted(store.get_all_service_names())[0]
+    names = sorted(store.get_span_names(service))
+    assert names
+    qr = QueryRequest(service_name=service, span_name=names[0],
+                      annotations=["some custom annotation"],
+                      end_ts=END_TS, limit=10)
+    resp = svc.get_trace_ids(qr)
+    by_name = {
+        i.trace_id
+        for i in store.get_trace_ids_by_name(service, names[0], END_TS, 10)
+    }
+    by_ann = {
+        i.trace_id for i in store.get_trace_ids_by_annotation(
+            service, "some custom annotation", None, END_TS, 10)
+    }
+    assert set(resp.trace_ids) <= (by_name & by_ann) or not resp.trace_ids

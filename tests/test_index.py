@@ -699,3 +699,90 @@ def test_dictionary_overflow_service_routes_to_scan():
         assert fast.service_duration_quantiles(svc, qs) == \
             big.service_duration_quantiles(svc, qs), svc
     assert fast.get_all_service_names() == big.get_all_service_names()
+
+
+def test_far_future_timestamps_stay_exact():
+    """Timestamps past the coarse ts-watermark domain (>= 2^51 µs,
+    ~year 2041) must take _index_write's EXACT overflow-fallback war
+    instead of saturating the coarse i32 domain: results match the
+    scan-only oracle both for wrapped (watermark-gated) and unwrapped
+    buckets, and the stored watermark stays an upper bound (never a
+    silently-wrapped underestimate that would certify a stale
+    window)."""
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+
+    # One annotation bucket, tiny depth: traffic wraps it, so answers
+    # ride the watermark trust gate — exactly where a broken overflow
+    # war would certify wrong windows.
+    cfg = _cfg(True, idx_ann_buckets=1, idx_ann_depth=64)
+    fast, scan = TpuSpanStore(cfg), TpuSpanStore(_cfg(False))
+    ep = Endpoint(1, 80, "futuresvc")
+    base = 1 << 52  # past the 2^(31+20) coarse ceiling
+    spans = [
+        Span(40_000 + i, "op", 1, None,
+             (Annotation(base + 10 * i, "sr", ep),
+              Annotation(base + 10 * i + 1, "future marker", ep)), ())
+        for i in range(150)  # wraps the single 64-deep bucket twice
+    ]
+    for st in (fast, scan):
+        st.apply(spans)
+    end_ts = base + 10_000
+    got = _ids(fast.get_trace_ids_by_annotation(
+        "futuresvc", "future marker", None, end_ts, 10))
+    want = _ids(scan.get_trace_ids_by_annotation(
+        "futuresvc", "future marker", None, end_ts, 10))
+    assert got == want
+    assert len(got) == 10  # real data answered, not a vacuous []
+    # The watermark must be a true upper bound on displaced ts (exact
+    # war), not an i32-saturated or wrapped value.
+    import numpy as np
+
+    lay, _, _ = fast.config.cand_layout
+    b_base, _, n_b, _ = lay[2]  # CAND_ANN family row
+    wm = np.asarray(fast.state.cand_wm)[b_base:b_base + n_b]
+    live_wm = wm[wm > -(2 << 60)]
+    assert live_wm.size and (live_wm >= base).all()
+    assert (live_wm <= base + 10 * 150 + (1 << 20)).all()
+
+
+def test_ts_watermark_coarse_boundary_window_stays_exact():
+    """Regression: a displaced ts in the LAST coarse unit below
+    2^(31+shift) µs used to ceil to exactly 2^31, wrap negative in the
+    i32 scatter, and silently UNDERSTATE the watermark (a wrapped
+    bucket could then certify a window missing displaced entries).
+    Such timestamps must route through the exact overflow war: results
+    match the scan oracle and the stored watermark stays >= the true
+    displaced maximum."""
+    import numpy as np
+
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+    from zipkin_tpu.store.device import _WM_TS_SHIFT
+
+    cfg = _cfg(True, idx_ann_buckets=1, idx_ann_depth=64)
+    fast, scan = TpuSpanStore(cfg), TpuSpanStore(_cfg(False))
+    ep = Endpoint(1, 80, "edgesvc")
+    # All 150 ts sit inside [(2^31 - 1) << shift, 2^(31+shift)) — the
+    # former wrap window (2^20 µs wide).
+    base = ((1 << 31) - 1) << _WM_TS_SHIFT
+    spans = [
+        Span(50_000 + i, "op", 1, None,
+             (Annotation(base + 5 * i, "sr", ep),
+              Annotation(base + 5 * i + 1, "edge marker", ep)), ())
+        for i in range(150)  # wraps the single 64-deep bucket twice
+    ]
+    for st in (fast, scan):
+        st.apply(spans)
+    end_ts = base + (1 << 19)
+    got = _ids(fast.get_trace_ids_by_annotation(
+        "edgesvc", "edge marker", None, end_ts, 10))
+    want = _ids(scan.get_trace_ids_by_annotation(
+        "edgesvc", "edge marker", None, end_ts, 10))
+    assert got == want
+    assert len(got) == 10
+    lay, _, _ = fast.config.cand_layout
+    b_base, _, n_b, _ = lay[2]  # CAND_ANN family row
+    wm = np.asarray(fast.state.cand_wm)[b_base:b_base + n_b]
+    live_wm = wm[wm > -(2 << 60)]
+    # 86 entries were displaced (150 - 64); the true max displaced ts
+    # is base + 5*85 + 1. The watermark must bound it from ABOVE.
+    assert live_wm.size and (live_wm >= base).all()

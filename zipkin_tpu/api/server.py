@@ -78,10 +78,24 @@ def _summary_json(s):
     }
 
 
+def _finite_or_none(v):
+    """JSON-safe float: json.dumps serializes inf/nan as the bare
+    tokens Infinity/NaN, which are NOT JSON — JSON.parse in every
+    browser rejects them. The Dependencies monoid zero is
+    (+inf, -inf) (Time.Top/Bottom, models/dependencies.py), so an
+    empty store's /api/dependencies used to emit invalid JSON. No data
+    serializes as null, the /api/quantiles convention."""
+    return v if v == v and abs(v) != float("inf") else None
+
+
 def _moments_json(m):
     return {
-        "count": m.count, "mean": m.mean, "stddev": m.stddev,
-        "m2": m.m2, "m3": m.m3, "m4": m.m4,
+        "count": m.count,
+        "mean": _finite_or_none(m.mean),
+        "stddev": _finite_or_none(m.stddev),
+        "m2": _finite_or_none(m.m2),
+        "m3": _finite_or_none(m.m3),
+        "m4": _finite_or_none(m.m4),
     }
 
 
@@ -226,6 +240,8 @@ class ApiServer:
             return 200, {"quantiles": qs, "durationsMicro": vals}
         if path == "/api/dependencies" or re.match(r"^/api/dependencies/", path):
             return self._dependencies(path, params)
+        if path == "/api/traces_exist":
+            return self._traces_exist(params)
         # Trace ids in paths are unsigned hex (upstream zipkin URL
         # convention; span_to_json emits the same form). A leading "-"
         # keeps accepting legacy signed-decimal callers unambiguously.
@@ -333,8 +349,8 @@ class ApiServer:
                     end_ts = int(raw)
         deps = self.query.get_dependencies(start_ts, end_ts)
         return 200, {
-            "startTime": deps.start_time,
-            "endTime": deps.end_time,
+            "startTime": _finite_or_none(deps.start_time),
+            "endTime": _finite_or_none(deps.end_time),
             "links": [
                 {
                     "parent": l.parent,
@@ -344,6 +360,20 @@ class ApiServer:
                 for l in deps.links
             ],
         }
+
+    def _traces_exist(self, params):
+        """tracesExist (zipkinQuery.thrift:154): which of the queried
+        ids have ANY stored span — the cheap batched membership probe
+        the thrift surface offers before a full trace fetch. Ids are
+        comma-separated unsigned hex (the /api/trace/<id> URL
+        convention; legacy signed decimal accepted). The TPU store
+        answers through the trace-membership gid buckets when their
+        exactness gate holds, the O(ring) scan otherwise."""
+        raw = _require(params, "traceIds")
+        tids = [_parse_trace_id(t.strip())
+                for t in raw.split(",") if t.strip()]
+        exist = self.query.traces_exist(tids)
+        return 200, {"exist": sorted(_hex_id(t) for t in exist)}
 
     def _is_pinned(self, trace_id: int):
         try:
@@ -390,6 +420,16 @@ class ApiServer:
         counters = getattr(self.query.store, "counters", None)
         if callable(counters):
             out.update({f"store.{k}": v for k, v in counters().items()})
+        coal = getattr(self.query, "coalescer", None)
+        if coal is not None:
+            # The read-path dispatch-floor observable: how many device
+            # launches cross-request micro-batching removed.
+            out.update({
+                "query.coalesce_batches": coal.batches,
+                "query.coalesce_queries": coal.queries,
+                "query.coalesce_launches_saved": coal.launches_saved,
+                "query.coalesce_max_batch": coal.max_batch,
+            })
         return out
 
 

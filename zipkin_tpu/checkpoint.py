@@ -44,7 +44,17 @@ _PINS_FILE = "pins.pkl"
 #    buckets per lap). Older snapshots carry half-size tr_idx arrays,
 #    so their trace families restore poisoned (scan serves) instead of
 #    silently misaligned.
-_REVISION = 10
+# 11: the trace-membership families merged into the candidate arena
+#    (one [slots, 3] entry array + one cursor/watermark pair for all
+#    seven families — tr_idx/tr_pos/tr_wm no longer exist), candidate
+#    ts watermarks war coarsely (stored values round UP to 2^20 µs —
+#    still upper bounds, so old exact values restore compatibly), and
+#    span_tab became [H, 2] i32 bit-planes (bitcast-identical; migrated
+#    losslessly below). Pre-11 cand_*/tr_* arrays are dropped: the
+#    candidate segment restores permanently untrusted (scan serves, the
+#    pre-index treatment) while the trace segment seeds wm = write_pos
+#    and self-heals after one ring lap.
+_REVISION = 11
 
 
 def _dict_dump(d) -> list:
@@ -449,36 +459,46 @@ def load(path: str, mesh=None):
     known = set(dev.StoreState._FIELDS)
     revision = meta.get("revision", 1)
     legacy = revision < 4
-    if revision < 10:
-        # The trace-membership geometry changed shape (rev-10 depth
-        # doubling): a pre-10 tr_idx/tr_pos/tr_wm would misalign against
-        # the new slot math while its cursors still claimed exactness.
-        # Drop the stale arrays and poison the family's trust (cursor
-        # past depth) so the scan serves restored spans — the same
-        # treatment pre-unification layouts get. The watermark seed is
-        # the restore-time write_pos, NOT +inf: wm = wp claims "any
-        # restored-era gid may have been displaced", which the trust
-        # gate (wm < write_pos - capacity) re-opens after one full ring
-        # lap, once every restored span is evicted and the fresh tr_idx
-        # is authoritative — ann_poison's self-healing pattern. A
-        # permanent I64_MAX would scan trace queries forever.
-        for k in ("tr_idx", "tr_pos", "tr_wm"):
+    if revision < 11:
+        # Revision 11 merged every index family into ONE arena: a
+        # pre-11 cand_idx/cand_pos/cand_wm (candidate families only)
+        # or tr_idx/tr_pos/tr_wm (gone from the schema) would misalign
+        # against the unified slot math while its cursors still claimed
+        # exactness. Drop the stale arrays and poison trust per
+        # segment:
+        # - candidate prefix: cursor past depth + wm at +inf — the
+        #   ts-watermark gate has no eviction-horizon analogue to heal
+        #   through, so restored candidate queries scan for the store's
+        #   remaining lifetime (the pre-index snapshot treatment);
+        # - trace suffix: wm seeds at the restore-time write_pos, NOT
+        #   +inf — wm = wp claims "any restored-era gid may have been
+        #   displaced", which the displaced-gid gate (wm < write_pos -
+        #   capacity) re-opens after one full ring lap, once every
+        #   restored span is evicted and the fresh entries are
+        #   authoritative (ann_poison's self-healing pattern).
+        for k in ("tr_idx", "tr_pos", "tr_wm",
+                  "cand_idx", "cand_pos", "cand_wm"):
             upd.pop(k, None)
-        shape = (config.trace_layout[1],)
+        n_total = config.idx_layout[1]
+        n_cand = config.cand_layout[1]
+        shape = (n_total,)
         if n_shards:
             shape = (n_shards,) + shape  # stacked sharded state
         big = jax.numpy.int64(1) << 60
-        upd["tr_pos"] = jax.numpy.full(shape, big, jax.numpy.int64)
+        upd["cand_pos"] = jax.numpy.full(shape, big, jax.numpy.int64)
+        is_cand = jax.numpy.arange(n_total) < n_cand
         wp = upd.get("write_pos")
         if wp is None:
-            wm_seed = jax.numpy.full(shape, dev.I64_MAX,
+            tr_seed = jax.numpy.full(shape, dev.I64_MAX,
                                      jax.numpy.int64)
         else:
             wp = jax.numpy.asarray(wp, jax.numpy.int64)
             if n_shards:
                 wp = wp.reshape((-1, 1))  # [n_shards] -> broadcastable
-            wm_seed = jax.numpy.broadcast_to(wp, shape)
-        upd["tr_wm"] = wm_seed
+            tr_seed = jax.numpy.broadcast_to(wp, shape)
+        upd["cand_wm"] = jax.numpy.where(
+            is_cand, jax.numpy.int64(dev.I64_MAX), tr_seed
+        )
     if revision < 9 and "key_tab" in upd:
         # Revisions < 9 stored exact 64-bit key words; the table is now
         # 31-bit fingerprints (i32). The packed words are recoverable
@@ -509,10 +529,21 @@ def load(path: str, mesh=None):
     # until the ring turns over (dev.poison_ann_trust below).
     pre_poison = revision < 7
     upd = {k: v for k, v in upd.items() if k in known}
-    if pre_poison and "span_tab" in upd:
+    if "span_tab" in upd and np.asarray(upd["span_tab"]).dtype == np.int64:
+        # Pre-rev-11 snapshots store the dep-join table as packed i64
+        # words; rev 11 keeps [H, 2] i32 bit-planes — a pure
+        # representation change, so the migration is a lossless bitcast
+        # (little-endian: word 0 is the low plane, matching
+        # lax.bitcast_convert_type). Gated on the stored DTYPE, not the
+        # revision, so a snapshot that already carries planes (however
+        # its meta is labeled) passes through untouched.
         tab = np.asarray(upd["span_tab"])
+        if pre_poison:
+            # Rev < 7 used 0 as the empty sentinel (now _TAB_EMPTY).
+            tab = np.where(tab == 0, dev._TAB_EMPTY, tab)
+        tab = np.ascontiguousarray(tab)
         upd["span_tab"] = jax.numpy.asarray(
-            np.where(tab == 0, dev._TAB_EMPTY, tab)
+            tab.view(np.int32).reshape(tab.shape + (2,))
         )
     if legacy:
         _migrate_legacy_live_links(data, upd, config, n_shards)

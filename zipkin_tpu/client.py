@@ -183,8 +183,17 @@ class ZipkinWSGIMiddleware:
 
         def capture_start_response(status, resp_headers, exc_info=None):
             status_holder.append(status)
-            resp_headers = list(resp_headers) + list(
-                resolved.emit().items())
+            # Filter any pre-existing X-B3-* response headers (case-
+            # insensitively) before appending ours: a nested tracing
+            # middleware (or the wrapped app itself) may already have
+            # emitted them, and a response carrying two conflicting
+            # X-B3-TraceId values makes the devtools panel link
+            # whichever it reads first (ADVICE r5). The OUTERMOST
+            # middleware resolved the request's ids — its echo wins.
+            resp_headers = [
+                (k, v) for k, v in resp_headers
+                if not k.lower().startswith("x-b3-")
+            ] + list(resolved.emit().items())
             return start_response(status, resp_headers, exc_info)
 
         try:
@@ -254,3 +263,12 @@ class QueryClient:
 
     def dependencies(self) -> dict:
         return self._get("/api/dependencies")
+
+    def traces_exist(self, trace_ids) -> List[str]:
+        """tracesExist over the HTTP surface: returns the unsigned-hex
+        ids (the query-response form) that have any stored span."""
+        ids = ",".join(
+            f"{t & (2**64 - 1):x}" if isinstance(t, int) else str(t)
+            for t in trace_ids
+        )
+        return self._get(f"/api/traces_exist?traceIds={ids}")["exist"]

@@ -41,6 +41,17 @@ def _forward_fill_last_true_index(flag):
     return jax.lax.cummax(idx)
 
 
+def _planes(x):
+    """i64[n] -> i32[n, 2] bit-planes (free bitcast). The join's
+    post-sort re-verification gathers key columns twice per column;
+    in plane form both are contiguous 8-byte i32 ROW gathers instead
+    of i64 gathers — the serialized cost class on this device family
+    (NOTES_r05 §2) — while equality on both planes is bitwise the
+    i64 equality."""
+    return jax.lax.bitcast_convert_type(jnp.asarray(x, jnp.int64),
+                                        jnp.int32)
+
+
 def lookup(
     build_keys: Sequence[jnp.ndarray],
     build_valid: jnp.ndarray,
@@ -74,14 +85,14 @@ def lookup(
     )
     sort_key = (mix_keys64(keys) << 1) | tag
     order = jnp.argsort(sort_key)
-    s_keys = [k[order] for k in keys]
+    s_key_planes = [_planes(k)[order] for k in keys]
     s_build = is_build[order]
     s_payload = payload[order]
     src = _forward_fill_last_true_index(s_build)
     src_c = jnp.clip(src, 0, n - 1)
     same_key = src >= 0
-    for k in s_keys:
-        same_key = same_key & (k[src_c] == k)
+    for kp in s_key_planes:
+        same_key = same_key & (kp[src_c] == kp).all(axis=-1)
     hit = same_key & ~s_build
     val = jnp.where(hit, s_payload[src_c], 0)
     # Scatter back to original probe order (build rows routed to the OOB

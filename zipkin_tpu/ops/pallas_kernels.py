@@ -13,6 +13,25 @@ The count array must fit VMEM (~16MB): S*B = 256×2048 f32 = 2MB and
 CMS 4×65536 i32 = 1MB both do. On CPU the kernels run in interpreter
 mode (tests); on TPU they compile natively. ``flat_histogram`` is the
 generic primitive; ``cms_update`` reuses it per sketch row.
+
+Why the INDEX-FAMILY scatter block is NOT a Pallas kernel (the r6
+decision, NOTES_r06.md §3 carries the arithmetic): the VMEM-residency
+trick above is what makes these kernels win, and it fundamentally does
+not transfer. The unified index arena at the bench geometry is
+~0.5-1.6 GB ([slots, 3] i64 entries) — 30-100x VMEM — and the
+destination slots are hash-scattered across ALL of it, so a Pallas
+version must stream HBM tiles exactly like XLA's scatter does, with no
+reuse to amortize: each of the ~1.4M batch rows touches 24 bytes of a
+~1 GB array once. The measured fast path (unique-index i32 plane
+scatters at ~4.5 ns/row, scripts/profile_scatter*.py) already runs
+within ~2x of the pure HBM write-bandwidth bound for that access
+pattern; the remaining gap is random-access DMA latency, which a
+hand-rolled kernel pays identically. The wins that WERE available —
+fewer passes over the rows (one rank sort, one displaced-row gather,
+one shared watermark scatter for all seven families) — are
+access-PATTERN restructurings, landed in store/device.py where XLA
+fuses them fine. A Pallas arena kernel would re-derive the same DMA
+schedule at much higher maintenance cost.
 """
 
 from __future__ import annotations

@@ -15,4 +15,5 @@ from zipkin_tpu.query.request import (  # noqa: F401
     QueryResponse,
 )
 from zipkin_tpu.query.adjusters import TimeSkewAdjuster  # noqa: F401
+from zipkin_tpu.query.coalesce import QueryCoalescer  # noqa: F401
 from zipkin_tpu.query.service import QueryService  # noqa: F401

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Sequence
 from zipkin_tpu.models.span import Span
 from zipkin_tpu.models.trace import Trace, TraceCombo, TraceSummary, TraceTimeline
 from zipkin_tpu.query.adjusters import TimeSkewAdjuster
+from zipkin_tpu.query.coalesce import QueryCoalescer
 from zipkin_tpu.query.request import (
     Order,
     QueryException,
@@ -27,6 +28,10 @@ from zipkin_tpu.store.base import IndexedTraceId, SpanStore
 # ThriftQueryService.scala:33).
 TRACE_TIMESTAMP_PADDING_US = 60 * 1_000_000
 DURATION_FETCH_BATCH = 500
+# Cross-request micro-batch window (s): concurrent getTraceIds calls
+# arriving within it share ONE device launch (query/coalesce.py) —
+# the read-path answer to the ~100 ms per-dispatch floor.
+DEFAULT_COALESCE_WINDOW_S = 0.002
 
 
 class QueryService:
@@ -35,10 +40,36 @@ class QueryService:
         store: SpanStore,
         adjust_clock_skew: bool = True,
         duration_batch: int = DURATION_FETCH_BATCH,
+        coalesce_window_s: Optional[float] = None,
     ):
         self.store = store
         self.adjust_clock_skew = adjust_clock_skew
         self.duration_batch = duration_batch
+        if coalesce_window_s is None:
+            # The window only pays against a per-dispatch floor. A
+            # store that overrides get_trace_ids_multi (the device
+            # stores' one-launch batched probe) gets the 2 ms window;
+            # host backends (memory/sql — the base class just loops
+            # the singular methods) keep window 0, so a lone request
+            # pays no sleep and concurrent ones still coalesce only
+            # when a batch is already in flight.
+            from zipkin_tpu.store.base import ReadSpanStore
+
+            batched = (type(store).get_trace_ids_multi
+                       is not ReadSpanStore.get_trace_ids_multi)
+            coalesce_window_s = (
+                DEFAULT_COALESCE_WINDOW_S if batched else 0.0
+            )
+        # EVERY trace-id lookup (not just the multi-slice rounds)
+        # routes through the coalescer, so N concurrent API requests
+        # cost one batched get_trace_ids_multi launch instead of N
+        # singular dispatches; results are exactly serial execution's
+        # (see QueryCoalescer).
+        self.coalescer = QueryCoalescer(store,
+                                        window_s=coalesce_window_s)
+
+    def _multi(self, queries) -> List[List[IndexedTraceId]]:
+        return self.coalescer.run(queries)
 
     # -- getTraceIds ----------------------------------------------------
 
@@ -47,9 +78,9 @@ class QueryService:
             raise QueryException("No service name provided")
         slices = self._slice_queries(qr)
         if not slices:
-            ids = self.store.get_trace_ids_by_name(
-                qr.service_name, None, qr.end_ts, qr.limit
-            )
+            ids = self._multi(
+                [("name", qr.service_name, None, qr.end_ts, qr.limit)]
+            )[0]
             return self._response(ids, qr)
         if len(slices) == 1:
             return self._response(self._query_slices(slices, qr), qr)
@@ -57,15 +88,16 @@ class QueryService:
         # timestamp they can all reach, pad by one minute, re-query all
         # slices aligned there, then intersect. Both rounds ride the
         # store's batched multi-query path (one device launch per round
-        # on the TPU store, instead of one per slice).
+        # on the TPU store, instead of one per slice) — and the
+        # cross-request coalescer on top of it.
         probes = [
-            i for ids in self.store.get_trace_ids_multi(
+            i for ids in self._multi(
                 [self._multi_query(s, qr, qr.end_ts, 1) for s in slices]
             ) for i in ids
         ]
         probe_ts = [i.timestamp for i in probes]
         aligned = (min(probe_ts) if probe_ts else 0) + TRACE_TIMESTAMP_PADDING_US
-        per_slice = self.store.get_trace_ids_multi([
+        per_slice = self._multi([
             self._multi_query(s, qr, aligned, qr.limit) for s in slices
         ])
         common = _intersect(per_slice)
@@ -95,23 +127,13 @@ class QueryService:
             return ("name", qr.service_name, key, end_ts, limit)
         return ("annotation", qr.service_name, key, value, end_ts, limit)
 
-    def _query_one(self, s, qr: QueryRequest, end_ts: int, limit: int
-                   ) -> List[IndexedTraceId]:
-        kind, key, value = s
-        if kind == "span":
-            return self.store.get_trace_ids_by_name(
-                qr.service_name, key, end_ts, limit
-            )
-        return self.store.get_trace_ids_by_annotation(
-            qr.service_name, key, value, end_ts, limit
-        )
-
     def _query_slices(self, slices, qr: QueryRequest, limit: Optional[int] = None
                       ) -> List[IndexedTraceId]:
-        out: List[IndexedTraceId] = []
-        for s in slices:
-            out.extend(self._query_one(s, qr, qr.end_ts, limit or qr.limit))
-        return out
+        per_slice = self._multi([
+            self._multi_query(s, qr, qr.end_ts, limit or qr.limit)
+            for s in slices
+        ])
+        return [i for ids in per_slice for i in ids]
 
     def _response(self, ids: Sequence[IndexedTraceId], qr: QueryRequest,
                   end_ts: int = -1) -> QueryResponse:
@@ -184,6 +206,14 @@ class QueryService:
 
     def trace_exists(self, trace_id: int) -> bool:
         return bool(self.store.traces_exist([trace_id]))
+
+    def traces_exist(self, trace_ids: Sequence[int]):
+        """Which of ``trace_ids`` have any stored span — the thrift
+        ``tracesExist(ids)`` method (zipkinQuery.thrift:154), served by
+        every backend's batched membership read (the TPU store answers
+        through the trace-membership gid buckets when their exactness
+        gate holds)."""
+        return self.store.traces_exist(trace_ids)
 
     # -- catalogs / aggregates -----------------------------------------
 

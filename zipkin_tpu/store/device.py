@@ -99,7 +99,7 @@ class StoreConfig(NamedTuple):
     # Trace-membership gid index (whole-trace fetch + durations).
     # buckets * depth >= 2 * ring capacity keeps the exactness gate
     # (everything a bucket displaced is already evicted) true in steady
-    # state — see _gid_index_write.
+    # state — see the trace-segment gate in _index_write.
     idx_trace_buckets: int = 0
     # Per-key cursor table slots (0 = 2x total candidate buckets). See
     # StoreState.key_tab.
@@ -183,22 +183,45 @@ class StoreConfig(NamedTuple):
         )
 
     # -- unified index layouts -------------------------------------------
-    # All candidate families live in ONE flat entry array (and one
-    # cursor/watermark array), written by ONE combined scatter per
-    # ingest step: per-family writes cost ~33 fused kernels each on a
-    # backend where per-kernel overhead dominates (NOTES_r03.md §3).
-    # Layout per family: (bucket_base, slot_base, n_buckets, depth).
+    # ALL index families — the four candidate families AND the three
+    # trace-membership sub-families — live in ONE flat [slots, 3] entry
+    # arena (and one cursor array + one watermark array), written by ONE
+    # combined rank-sort + scatter pass per ingest step: per-family
+    # writes cost ~33 fused kernels each on a backend where per-kernel
+    # overhead dominates (NOTES_r03.md §3), and the r5 ablation put the
+    # two separate write blocks at 380 ms of the 586 ms step. Layout per
+    # family: (bucket_base, slot_base, n_buckets, depth). The candidate
+    # families are the arena PREFIX, so probe-side consumers of
+    # ``cand_layout`` see unchanged bases; the trace families follow
+    # (their rows spend the verify/ts columns on a trace-mix word and
+    # the row ts — the arena-tripling cost NOTES_r05 §2 priced in).
 
     @property
-    def cand_layout(self):
+    def idx_layout(self):
+        B = self.trace_buckets
         return _pack_layout((
             (self.max_services, self.svc_depth),
             (self.name_buckets, self.name_depth),
             (self.ann_buckets, self.ann_depth),
             (self.bann_buckets, self.bann_depth),
+            (B, self.TRACE_SPAN_DEPTH),
+            (B, self.TRACE_ANN_DEPTH),
+            (B, self.TRACE_BANN_DEPTH),
         ))
 
     CAND_SVC, CAND_NAME, CAND_ANN, CAND_BANN = range(4)
+    N_CAND_FAMILIES = 4
+
+    @property
+    def cand_layout(self):
+        """The candidate-family prefix of the unified arena, in the
+        historical (rows, total_buckets, total_slots) shape — totals
+        count the CANDIDATE families only (key-table sizing and probe
+        padding depend on them, not on the trace suffix)."""
+        rows, _, _ = self.idx_layout
+        cand = rows[: self.N_CAND_FAMILIES]
+        b_base, s_base, n_b, depth = cand[-1]
+        return cand, b_base + n_b, s_base + n_b * depth
 
     @property
     def key_slots(self) -> int:
@@ -208,11 +231,11 @@ class StoreConfig(NamedTuple):
 
     @property
     def trace_layout(self):
-        B = self.trace_buckets
-        return _pack_layout((
-            (B, self.TRACE_SPAN_DEPTH), (B, self.TRACE_ANN_DEPTH),
-            (B, self.TRACE_BANN_DEPTH),
-        ))
+        """Trace-membership rows of the unified arena: bases are GLOBAL
+        (into cand_idx/cand_pos/cand_wm); totals are the unified
+        totals."""
+        rows, total_b, total_s = self.idx_layout
+        return rows[self.N_CAND_FAMILIES:], total_b, total_s
 
     TR_SPAN, TR_ANN, TR_BANN = range(3)
 
@@ -288,6 +311,21 @@ def _uset(arr, idx, vals, ok):
         return _p64(jnp.stack([lo, hi], axis=-1))
     return arr.at[safe].set(jnp.asarray(vals, arr.dtype), mode="drop",
                             unique_indices=True)
+
+
+def _uset_p(arr2, idx, vals, ok):
+    """``arr2`` is an [M, 2] i32 PLANE-PAIR array (the bit-planes of a
+    logical i64 vector, kept in plane form so every load is an 8-byte
+    i32 row gather instead of an i64 gather — i64 gathers are the
+    dominant cost class on this backend, NOTES_r05 §2). Scatter-set of
+    logical i64 ``vals`` at unique ``idx`` among ok rows."""
+    v = _p32(jnp.asarray(vals, jnp.int64))
+    safe = _oob_unique(idx, ok, arr2.shape[0])
+    lo = arr2[:, 0].at[safe].set(v[:, 0], mode="drop",
+                                 unique_indices=True)
+    hi = arr2[:, 1].at[safe].set(v[:, 1], mode="drop",
+                                 unique_indices=True)
+    return jnp.stack([lo, hi], axis=-1)
 
 
 def _uset_cols64(arr, idx, vals, ok):
@@ -397,7 +435,7 @@ def _slot_war(slot, packed, active, n_slots: int):
 _LO_FLIP = jnp.int32(-0x80000000)  # sign-flip: u32 order as i32 order
 
 # Coarse gid-watermark granularity divisor: overstatement is bounded by
-# capacity / 2^_WM_COARSE_FRAC_BITS (see _war_max_gid_coarse and the
+# capacity / 2^_WM_COARSE_FRAC_BITS (see _coarse_gid32 and the
 # wm_shift derivation in ingest_step).
 _WM_COARSE_FRAC_BITS = 8
 
@@ -444,35 +482,65 @@ def _war_min64(arr, idx, vals, ok):
     return ~_war_max64(~arr, idx, ~jnp.asarray(vals, jnp.int64), ok)
 
 
-def _war_max_gid_coarse(arr, idx, gids, ok, shift: int):
-    """Conservative ``arr.at[idx[ok]].max(gids[ok])`` for a GID
-    watermark, in coarse 2^shift units: ONE i32 duplicate-index
-    scatter-max (vectorized) instead of _war_max64's two plane wars +
-    settled gather. Each contribution rounds UP to the next coarse
-    boundary, so the stored watermark OVERSTATES the true max displaced
-    gid by < 2^shift — against trust margins of >= ring capacity
-    (displaced entries are ring-laps old in steady state), callers pick
-    shift so the overstatement is a sub-percent slice of the margin.
-    Overstating a watermark costs scan fallbacks, never a wrong answer.
-    Untouched slots keep their exact i64 value (the i32 war runs on a
-    zeroed scratch; only slots it actually raised fold back), so empty
-    I64_MIN sentinels — and underfull-bucket trust before the first
-    wrap — survive bit-exact. gids are non-negative; the coarse domain
-    holds to 2^(31 + shift) spans of lifetime (2^45+ at bench shapes),
-    and gids past it SATURATE to the domain ceiling — the watermark
-    pins high and the gates stay conservatively closed, never silently
-    re-open (an unclamped int32 cast would wrap negative and freeze
-    the watermark instead)."""
-    n = arr.shape[0]
-    val32 = jnp.minimum(
+def _coarse_gid32(gids, ok, shift: int):
+    """Per-row i32 contribution of a GID to the SHARED coarse watermark
+    scatter (_index_write's unified war — one vectorized i32
+    duplicate-index scatter-max instead of _war_max64's two plane wars
+    + settled gather per family): ceil to the next 2^shift boundary, so
+    the stored watermark OVERSTATES the true max displaced gid by
+    < 2^shift — against trust margins of >= ring capacity (displaced
+    entries are ring-laps old in steady state), callers pick shift so
+    the overstatement is a sub-percent slice of the margin. Overstating
+    a watermark costs scan fallbacks, never a wrong answer. ~ok rows
+    contribute 0 (the zeroed scratch's no-op), so untouched slots keep
+    their exact i64 value on fold-back — empty I64_MIN sentinels, and
+    underfull-bucket trust before the first wrap, survive bit-exact.
+    gids are non-negative; the coarse domain holds to 2^(31 + shift)
+    spans of lifetime (2^45+ at bench shapes), and gids past it
+    SATURATE to the domain ceiling — the watermark pins high and the
+    gates stay conservatively closed, never silently re-open (an
+    unclamped int32 cast would wrap negative and freeze the watermark
+    instead). Callers must route shift == 0 through the exact
+    _war_max64 path instead: the un-shifted domain saturates at ~2.1B
+    lifetime spans, an unrecoverable cliff for long-lived small stores
+    (ADVICE r5) — _index_write's exact_gid_wars branch does."""
+    v = jnp.minimum(
         (jnp.asarray(gids, jnp.int64) >> shift) + 1,
         jnp.int64(0x7FFFFFFF),
     ).astype(jnp.int32)
-    safe = jnp.where(ok, idx.astype(jnp.int32), n)
-    tmp = jnp.zeros(n + 1, jnp.int32).at[safe].max(
-        jnp.where(ok, val32, 0), mode="drop")[:n]
-    upd = jnp.where(tmp > 0, tmp.astype(jnp.int64) << shift, I64_MIN)
-    return jnp.maximum(arr, upd)
+    return jnp.where(ok & (jnp.asarray(gids, jnp.int64) >= 0), v, 0)
+
+
+# Coarse-ts watermark granularity: candidate-family overwrite
+# watermarks war in 2^_WM_TS_SHIFT-µs units (~1.05 s). The trust gate
+# compares a query's limit-th candidate ts against the watermark;
+# displaced entries are ring-laps (minutes+) older than any trusted
+# candidate in steady state, so a <= 1.05 s ceil overstatement costs at
+# most a rare extra scan fallback, never a wrong answer. Contributions
+# past the coarse ceiling (ts >= 2^(31+shift) µs, ~year 2041) take the
+# EXACT plane-war fallback below instead of saturating — saturation
+# would close the bucket forever.
+_WM_TS_SHIFT = 20
+
+
+def _coarse_ts32(ts, ok, shift: int):
+    """Per-row i32 contribution of a displaced TS to the shared coarse
+    watermark scatter: ceil in 2^shift-µs units. Negative ts (the
+    I64_MIN / NO_TS sentinels) contribute nothing — a displaced entry
+    without a timestamp can never match a query (the kernels require
+    ts >= 0), so omitting it cannot un-protect an answer. Rows at or
+    past the coarse ceiling ALSO contribute nothing here; the caller
+    MUST route exactly those rows (the overflow mask) through the
+    exact war (_index_write's cond). The ceiling is
+    (2^31 - 1) << shift, NOT 2^(31+shift): a ts in the last coarse
+    unit below 2^(31+shift) would ceil to exactly 2^31, whose i32 cast
+    wraps NEGATIVE — losing the scatter-max and silently UNDERSTATING
+    the watermark, the one failure direction the gates can't absorb."""
+    t = jnp.asarray(ts, jnp.int64)
+    lim = jnp.int64((1 << 31) - 1) << shift
+    in_dom = ok & (t >= 0) & (t < lim)
+    v = ((t >> shift) + 1).astype(jnp.int32)
+    return jnp.where(in_dom, v, 0), ok & (t >= lim)
 
 
 def _ring(n, dtype, fill=0):
@@ -545,7 +613,14 @@ class StoreState:
     dep_bank_seq: jnp.ndarray  # scalar i64 — next bucket slot
     dep_window: jnp.ndarray  # [S*S, 5] f32 — accumulating current bucket
     dep_window_ts: jnp.ndarray  # [2] i64 — ts range folded into window
-    span_tab: jnp.ndarray  # [H] i64 — (mix48 << 16)|(svc+1 << 1)|1; 0 empty
+    # Dep-join hash table, stored as the [H, 2] i32 BIT-PLANES of the
+    # logical packed word (mix48 << 16)|(svc+1 << 1)|1 (_TAB_EMPTY when
+    # free): every probe round's load is then an 8-byte i32 row gather
+    # instead of an i64 gather — the dominant cost class on this
+    # backend (NOTES_r05 §2) — and every store a pair of vectorized
+    # i32 plane scatters. Bitcast-identical to the old i64 column
+    # (checkpoint revision 11 migrates by view, losslessly).
+    span_tab: jnp.ndarray  # [H, 2] i32 — planes of the packed word
     pend_key: jnp.ndarray  # [Q] i64 — (mix48(tid,parent) << 16)|(csvc+1<<1)|1
     pend_dur: jnp.ndarray  # [Q] i64 — pending child duration
     pend_tsf: jnp.ndarray  # [Q] i64 — pending child first_ts
@@ -553,26 +628,26 @@ class StoreState:
     pend_pos: jnp.ndarray  # scalar i64 — pending ring cursor
 
     # -- index column families -------------------------------------------
-    # Candidate families (service / service+name / service+ann-value /
-    # service+binary) share ONE flat [total_slots, 3] i64 entry array
-    # (gid, verify, ts), one [total_buckets] i64 cursor array, and one
-    # watermark array, laid out per StoreConfig.cand_layout. A bucket's
-    # FIFO ring never wrapping (cursor <= depth) means it holds EVERY
-    # entry ever written for its key → an index read is complete; a
-    # wrapped bucket is still exact when the query's last candidate
-    # ranks >= the watermark (see _index_write).
+    # ALL seven index families — the four candidate families (service /
+    # service+name / service+ann-value / service+binary) AND the three
+    # trace-membership sub-families — share ONE flat [total_slots, 3]
+    # i64 entry arena of (gid, verify, ts) rows, one [total_buckets]
+    # i64 cursor array, and one watermark array, laid out per
+    # StoreConfig.idx_layout (candidate families are the prefix; the
+    # probe-side ``cand_layout`` view is unchanged). One combined
+    # rank-sort + scatter pass serves every family (_index_write). A
+    # bucket's FIFO ring never wrapping (cursor <= depth) means it
+    # holds EVERY entry ever written for its key → an index read is
+    # complete; a wrapped CANDIDATE bucket is still exact when the
+    # query's last candidate ranks >= its ts watermark, and a wrapped
+    # TRACE bucket when everything it displaced is already evicted
+    # (gid watermark < write_pos - capacity) — the exactness gate for
+    # whole-trace fetch and durations. The watermark array carries ts
+    # values on the candidate prefix and gids on the trace suffix;
+    # every query slices by family, never across the boundary.
     cand_idx: jnp.ndarray
     cand_pos: jnp.ndarray
     cand_wm: jnp.ndarray
-    # Trace-membership family: [total_slots] i64 row gids bucketed by
-    # trace-id hash, one sub-family per ring (StoreConfig.trace_layout);
-    # wm = max DISPLACED gid. A bucket provably holds every RESIDENT
-    # row of its traces when everything it ever displaced is already
-    # evicted (wm < write_pos - capacity) — the exactness gate for
-    # whole-trace fetch and durations.
-    tr_idx: jnp.ndarray
-    tr_pos: jnp.ndarray
-    tr_wm: jnp.ndarray
     # Middle-host trust: annotation/binary index entries are written
     # under a span's (min, max) annotation-host pair, so a span whose
     # annotations span 3+ DISTINCT host services is never indexed under
@@ -622,7 +697,7 @@ class StoreState:
         "dep_moments", "dep_banks", "dep_bank_ts", "dep_overflow_ts",
         "dep_bank_seq", "dep_window", "dep_window_ts", "span_tab",
         "pend_key", "pend_dur", "pend_tsf", "pend_tsl", "pend_pos",
-        "cand_idx", "cand_pos", "cand_wm", "tr_idx", "tr_pos", "tr_wm",
+        "cand_idx", "cand_pos", "cand_wm",
         "ann_poison", "key_tab", "key_wm",
         "svc_hist", "svc_span_counts", "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
@@ -688,13 +763,13 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         dep_bank_seq=jnp.int64(0),
         dep_window=jnp.zeros((S * S, M.N_FIELDS), jnp.float32),
         dep_window_ts=jnp.array([I64_MAX, I64_MIN], jnp.int64),
-        span_tab=jnp.full(c.tab_slots, _TAB_EMPTY, jnp.int64),
+        span_tab=_p32(jnp.full(c.tab_slots, _TAB_EMPTY, jnp.int64)),
         pend_key=jnp.zeros(c.pending_slots, jnp.int64),
         pend_dur=jnp.zeros(c.pending_slots, jnp.int64),
         pend_tsf=jnp.zeros(c.pending_slots, jnp.int64),
         pend_tsl=jnp.zeros(c.pending_slots, jnp.int64),
         pend_pos=jnp.int64(0),
-        # LOAD-BEARING init values: _index_write/_gid_index_write derive
+        # LOAD-BEARING init values: _index_write derives
         # slot occupancy from cursors (pos + rank >= depth), which
         # over-claims when an in-batch bucket overflow (cnt > depth)
         # skipped slots this cursor lap — such "occupied" slots still
@@ -704,12 +779,9 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         # max-war and verify = -1 hashes to a fingerprint that matches
         # no claimed key. Changing these fills requires re-deriving that
         # argument (or adding an explicit old-entry validity check).
-        cand_idx=jnp.full((c.cand_layout[2], 3), -1, jnp.int64),
-        cand_pos=jnp.zeros(c.cand_layout[1], jnp.int64),
-        cand_wm=jnp.full(c.cand_layout[1], I64_MIN, jnp.int64),
-        tr_idx=jnp.full(c.trace_layout[2], -1, jnp.int64),
-        tr_pos=jnp.zeros(c.trace_layout[1], jnp.int64),
-        tr_wm=jnp.full(c.trace_layout[1], I64_MIN, jnp.int64),
+        cand_idx=jnp.full((c.idx_layout[2], 3), -1, jnp.int64),
+        cand_pos=jnp.zeros(c.idx_layout[1], jnp.int64),
+        cand_wm=jnp.full(c.idx_layout[1], I64_MIN, jnp.int64),
         ann_poison=jnp.full(S, I64_MIN, jnp.int64),
         key_tab=jnp.full(c.key_slots, _FP_EMPTY, jnp.int32),
         key_wm=jnp.full(c.key_slots, I64_MIN, jnp.int64),
@@ -1060,11 +1132,14 @@ def _tab_slots(key48, n_slots: int):
 
 
 def _tab_lookup(tab, key48):
-    """(found, svc) per probe key — svc is -1 when absent/serviceless."""
+    """(found, svc) per probe key — svc is -1 when absent/serviceless.
+    ``tab`` is the [H, 2] i32 plane-pair table (StoreState.span_tab):
+    each probe load is an 8-byte i32 row gather, bitcast locally back
+    to the logical packed word."""
     found = jnp.zeros(key48.shape, bool)
     svc = jnp.full(key48.shape, -1, jnp.int32)
     for slot in _tab_slots(key48, tab.shape[0]):
-        cur = tab[slot].astype(jnp.uint64)
+        cur = _p64(tab[slot]).astype(jnp.uint64)
         hit = (cur != jnp.uint64(_TAB_EMPTY)) & (
             (cur >> jnp.uint64(16)) == key48)
         first = hit & ~found
@@ -1102,10 +1177,12 @@ def _tab_insert(tab, key48, svc, valid):
     # Each round's min-war is arbitrated EXPLICITLY (_slot_war sorts the
     # contenders) instead of by an i64 scatter-min + re-read — bitwise
     # the same winner (numerically smallest packed word), but built
-    # from sorts and one unique plane scatter (i64 scatters serialize
-    # at ~100 ns/row on this backend, profile_scatter*.py).
+    # from sorts and one unique plane scatter. The table itself lives
+    # in i32 plane form (StoreState.span_tab): probe loads are i32 row
+    # gathers, writes i32 plane scatters — i64 gathers/scatters are the
+    # serialized class on this backend (profile_scatter*.py).
     for slot in slots:
-        cur = tab[slot]
+        cur = _p64(tab[slot])
         curu = cur.astype(jnp.uint64)
         open_ = (curu == jnp.uint64(_TAB_EMPTY)) | (
             (curu >> jnp.uint64(16)) == key48
@@ -1113,13 +1190,13 @@ def _tab_insert(tab, key48, svc, valid):
         attempt = ~placed & open_
         seg_min, write_row = _slot_war(slot, packed, attempt, oob)
         after = jnp.minimum(cur, seg_min)  # inactive rows: seg_min=MAX
-        tab = _uset(tab, slot, after, write_row)
+        tab = _uset_p(tab, slot, after, write_row)
         placed |= attempt & (
             (after.astype(jnp.uint64) >> jnp.uint64(16)) == key48)
     # Last-resort steal: the old state is discarded, so the winner is
     # simply the smallest packed word among same-slot stealers.
     seg_min, write_row = _slot_war(slots[-1], packed, ~placed, oob)
-    return _uset(tab, slots[-1], seg_min, write_row)
+    return _uset_p(tab, slots[-1], seg_min, write_row)
 
 
 # -- index column families ---------------------------------------------------
@@ -1162,34 +1239,51 @@ def _fifo_ranks(bucket, valid, n_buckets: int):
     return rank
 
 
-def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
-                 depth, gid, verify, ts, valid, keyed_from: int,
-                 wm_shift: int = 0):
-    """ONE combined append of (gid, verify, ts) rows into the unified
-    candidate-family entry array: ``gbucket`` is the global bucket id
-    (addressing pos/wm), ``slot0`` the bucket's first entry row, and
-    ``depth`` its FIFO depth — all per-row vectors, constant per
-    concatenated family segment, so every family rides the same sort,
-    scatter, and cursor update (per-kernel overhead dominates on this
-    backend, NOTES_r03.md §3).
+def _index_write(entries, pos, wm, key_tab, key_wm, ann_poison,
+                 gbucket, slot0, depth, gid, verify, ts, valid,
+                 keyed_from: int, n_cand_rows: int, n_cand_buckets: int,
+                 poison_bucket=None, poison_gid=None, poison_ok=None,
+                 wm_shift: int = 0, ts_shift: int = _WM_TS_SHIFT):
+    """ONE combined append of (gid, verify, ts) rows into the UNIFIED
+    index arena — candidate families and trace-membership families
+    alike: ``gbucket`` is the global bucket id (addressing pos/wm),
+    ``slot0`` the bucket's first entry row, and ``depth`` its FIFO
+    depth — all per-row vectors, constant per concatenated family
+    segment, so every family rides the same rank sort, count scatter,
+    displaced-row gather, entry scatter, and cursor update (per-kernel
+    overhead dominates on this backend, NOTES_r03.md §3; the r5 split
+    cand/trace write blocks cost two of everything).
 
-    ``wm`` is the per-bucket overwrite watermark: the max ts ever
-    displaced (by wraparound, or by in-batch overflow where one launch
-    writes more than ``depth`` rows to a bucket and keeps the newest).
-    Queries on a wrapped bucket are exact iff their last returned
-    candidate still ranks >= the watermark.
+    Row sections (static slices of the concatenation):
+    - ``[0:n_cand_rows)``  candidate-family rows. Their buckets' ``wm``
+      is the overwrite TS watermark: the max ts ever displaced (by
+      wraparound, or by in-batch overflow where one launch writes more
+      than ``depth`` rows to a bucket and keeps the newest). Queries on
+      a wrapped bucket are exact iff their last returned candidate
+      still ranks >= the watermark. The war runs COARSE — one shared
+      vectorized i32 duplicate-index scatter-max in 2^ts_shift-µs ceil
+      units (see _WM_TS_SHIFT) — with an EXACT plane-war fallback,
+      entered under lax.cond only when some contribution lies past the
+      coarse domain (costs nothing on real traffic).
+    - ``[n_cand_rows:)``  trace-membership rows. Their buckets' ``wm``
+      is the max DISPLACED GID (ring overwrite order is oldest-first,
+      so wm < write_pos - capacity proves the bucket holds every
+      resident row of its traces). The war rides the SAME shared
+      scatter in 2^wm_shift-gid units (except wm_shift == 0: exact —
+      see _war_max_gid_coarse's small-store rationale).
 
     ``key_tab``/``key_wm`` is the per-key cursor table (see
-    StoreState.key_tab); rows from ``keyed_from`` on (the keyed
-    families are a contiguous SUFFIX of the concatenation — the
-    service family, whose bucket IS the key, comes first) claim a
-    record for their verify word, and every displaced or
-    in-batch-dropped keyed entry scatter-maxes its span gid into its
-    key's displaced watermark. Also returns the number of keyed rows
-    whose claim found no slot (table congestion): while that count is
-    ZERO over the store's lifetime, an ABSENT record proves its key
-    was never indexed — the negative-lookup gate (see iquery
-    wrappers)."""
+    StoreState.key_tab); rows in ``[keyed_from:n_cand_rows)`` (the
+    keyed families are a contiguous MIDDLE slice — the service family,
+    whose bucket IS the key, leads, and the trace families trail) claim
+    a record for their verify word, and every displaced or
+    in-batch-dropped keyed entry maxes its span gid into its key's
+    displaced watermark — through the same shared scatter. So do the
+    middle-host ``ann_poison`` contributions (``poison_*``, per
+    annotation row). Also returns the number of keyed rows whose claim
+    found no slot (table congestion): while that count is ZERO over the
+    store's lifetime, an ABSENT record proves its key was never indexed
+    — the negative-lookup gate (see iquery wrappers)."""
     n_b = pos.shape[0]
     rank = _fifo_ranks(gbucket, valid, n_b)
     b_c = jnp.clip(gbucket, 0, n_b - 1)
@@ -1213,21 +1307,33 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     # watermark war and match no key fingerprint; see init_state).
     occupied = keep & (pos_b + rank >= depth)
     gidx = jnp.where(keep, slot, 0)
-    # ONE row gather of the displaced entries: profiled ~3x cheaper
-    # than per-column i64 gathers on this backend (the [N, 3] rows are
-    # contiguous 24-byte reads; scripts/profile_ingest.py arm 8b — the
-    # measured end-to-end step win was 166.5k -> 195.6k spans/s).
+    # ONE row gather of the displaced entries for ALL families:
+    # profiled ~3x cheaper than per-column i64 gathers on this backend
+    # (the [N, 3] rows are contiguous 24-byte reads;
+    # scripts/profile_ingest.py arm 8b).
     old_rows = entries[gidx]
-    old_ts = jnp.where(occupied, old_rows[:, 2], I64_MIN)
-    # Old entry identity is only consumed by the (suffix-only) key
-    # machinery below.
-    sfx = slice(keyed_from, None)
+    cand = slice(0, n_cand_rows)
+    trc = slice(n_cand_rows, None)
+    old_ts_c = jnp.where(occupied[cand], old_rows[cand, 2], I64_MIN)
+    # Old entry identity is consumed by the keyed-slice machinery below.
+    sfx = slice(keyed_from, n_cand_rows)
     old_gid_s = old_rows[sfx, 0]
     old_verify_s = old_rows[sfx, 1]
-    dropped_ts = jnp.where(valid & ~keep, jnp.asarray(ts, jnp.int64),
-                           I64_MIN)
-    wm = _war_max64(wm, oob_b, jnp.maximum(old_ts, dropped_ts), valid)
+    dropped_ts = jnp.where(
+        valid[cand] & ~keep[cand],
+        jnp.asarray(ts, jnp.int64)[cand], I64_MIN,
+    )
+    disp_ts = jnp.maximum(old_ts_c, dropped_ts)
+    # Trace rows: the watermark needs the TRUE displaced gid (from the
+    # shared old-row gather) — under continuous displacement the
+    # displaced entry is ~2 window-laps old and already ring-evicted,
+    # which is exactly what keeps the gate passing in steady state;
+    # substituting the current row's (always-recent) gid would hold
+    # every busy bucket's gate closed forever. In-batch dropped rows
+    # carry their own gid.
     gid = jnp.asarray(gid, jnp.int64)
+    tr_wmv = jnp.where(occupied[trc], old_rows[trc, 0], gid[trc])
+    tr_ok = occupied[trc] | (valid[trc] & ~keep[trc])
     verify = jnp.asarray(verify, jnp.int64)
     vals = jnp.stack([gid, verify, jnp.asarray(ts, jnp.int64)], axis=-1)
     entries = _uset_cols64(entries, slot, vals, keep)
@@ -1303,58 +1409,86 @@ def _index_write(entries, pos, wm, key_tab, key_wm, gbucket, slot0,
     dslot = jnp.full(k48d.shape, T, jnp.int32)
     for i in range(_KEY_PROBES - 1, -1, -1):
         dslot = jnp.where(dhit[i], dslots3[i], dslot)
-    # Coarse-ceil gid war (same trust margin as the bucket gates).
-    key_wm = _war_max_gid_coarse(key_wm, dslot, disp_gid,
-                                 disp_ok & dhit.any(0), wm_shift)
+    key_hit = disp_ok & dhit.any(0)
+
+    # -- the SHARED watermark war --------------------------------------
+    # Every watermark family — candidate ts watermarks, trace-family
+    # displaced-gid watermarks, per-key displaced-gid watermarks, and
+    # the middle-host ann_poison stamps — folds through ONE vectorized
+    # i32 duplicate-index scatter-max over a partitioned scratch, each
+    # contribution pre-encoded in its own family's coarse unit (the
+    # buckets are disjoint, so mixed units can share a scatter). The r5
+    # step paid one war per family (the "+ bucket wm war off" 73 ms
+    # ablation slice plus three coarse gid scatters); this is one.
+    valid_c = valid[cand]
+    S_p = ann_poison.shape[0]
+    n_scr = n_b + T + S_p + 1
+    val_c, over_c = _coarse_ts32(disp_ts, valid_c, ts_shift)
+    idx_c = jnp.where(valid_c, b_c[cand], n_scr - 1)
+    parts_idx = [idx_c]
+    parts_val = [val_c]
+    exact_gid_wars = wm_shift == 0  # small-store satellite: no cliff
+    if not exact_gid_wars:
+        parts_idx.append(jnp.where(tr_ok, b_c[trc], n_scr - 1))
+        parts_val.append(_coarse_gid32(tr_wmv, tr_ok, wm_shift))
+        parts_idx.append(jnp.where(key_hit, n_b + dslot, n_scr - 1))
+        parts_val.append(_coarse_gid32(disp_gid, key_hit, wm_shift))
+        if poison_bucket is not None:
+            parts_idx.append(jnp.where(
+                poison_ok,
+                n_b + T + jnp.clip(poison_bucket, 0, S_p - 1),
+                n_scr - 1,
+            ))
+            parts_val.append(
+                _coarse_gid32(poison_gid, poison_ok, wm_shift))
+    scr = jnp.zeros(n_scr, jnp.int32).at[
+        jnp.concatenate(parts_idx)
+    ].max(jnp.concatenate(parts_val), mode="drop")
+    # Fold back per segment: only slots the war actually raised touch
+    # their exact i64 state (empty I64_MIN sentinels survive bit-exact).
+    scr_b = scr[:n_b]
+    ts_upd = jnp.where(scr_b > 0, scr_b.astype(jnp.int64) << ts_shift,
+                       I64_MIN)
+    if exact_gid_wars:
+        wm = jnp.maximum(
+            wm,
+            jnp.where(jnp.arange(n_b) < n_cand_buckets, ts_upd, I64_MIN),
+        )
+        wm = _war_max64(wm, b_c[trc], tr_wmv, tr_ok)
+        key_wm = _war_max64(key_wm, dslot, disp_gid, key_hit)
+        if poison_bucket is not None:
+            ann_poison = _war_max64(
+                ann_poison, jnp.clip(poison_bucket, 0, S_p - 1),
+                jnp.asarray(poison_gid, jnp.int64), poison_ok,
+            )
+    else:
+        gid_upd = jnp.where(
+            scr_b > 0, scr_b.astype(jnp.int64) << wm_shift, I64_MIN)
+        wm = jnp.maximum(
+            wm,
+            jnp.where(jnp.arange(n_b) < n_cand_buckets, ts_upd, gid_upd),
+        )
+        scr_k = scr[n_b:n_b + T]
+        key_wm = jnp.maximum(key_wm, jnp.where(
+            scr_k > 0, scr_k.astype(jnp.int64) << wm_shift, I64_MIN))
+        if poison_bucket is not None:
+            scr_p = scr[n_b + T:n_b + T + S_p]
+            ann_poison = jnp.maximum(ann_poison, jnp.where(
+                scr_p > 0, scr_p.astype(jnp.int64) << wm_shift,
+                I64_MIN))
+    # Exact overflow fallback for the ts war: contributions past the
+    # coarse ceiling run the exact plane war instead of saturating (a
+    # saturated ts watermark would close its bucket forever). lax.cond
+    # executes one branch at runtime, so real traffic (no overflow)
+    # pays a scalar reduction, not the war.
+    wm = jax.lax.cond(
+        over_c.any(),
+        lambda w: _war_max64(w, b_c[cand], disp_ts, over_c),
+        lambda w: w,
+        wm,
+    )
     n_drops = (v_s & ~placed).sum().astype(jnp.int64)
-    return entries, pos, wm, key_tab, key_wm, n_drops
-
-
-def _gid_index_write(entries, pos, wm, gbucket, slot0, depth, gid, valid,
-                     wm_shift: int = 0):
-    """Combined gid-only variant for the trace-membership sub-families;
-    ``wm`` tracks the max gid ever displaced. Ring overwrite order is
-    oldest-first, so once wm < (ring write_pos - ring capacity),
-    everything a bucket lost is already evicted and the bucket provably
-    holds every RESIDENT row of its traces — the query-time exactness
-    gate. Sizing buckets*depth >= 4x the ring keeps the gate true in
-    steady state even under trace clumping (a trace's rows all land in
-    ONE bucket, so per-lap bucket traffic is Poisson over a couple of
-    traces — at 2x coverage that variance measurably wrapped 13-30% of
-    buckets faster than a ring lap); only a trace hotter than ``depth``
-    rows per family keeps its own gate false forever, which the scan
-    fallback covers."""
-    n_b = pos.shape[0]
-    rank = _fifo_ranks(gbucket, valid, n_b)
-    b_c = jnp.clip(gbucket, 0, n_b - 1)
-    oob_b = jnp.where(valid, b_c, n_b)
-    cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
-        1, mode="drop")[:n_b]
-    keep = valid & (rank >= cnt[b_c] - depth)
-    # i32 low-plane cursor math + cursor-derived displacement test,
-    # exactly as in _index_write. The watermark needs the TRUE displaced
-    # gid (one i64 gather): under continuous displacement the displaced
-    # entry is ~2 window-laps old and already ring-evicted, so the
-    # exactness gate keeps passing in steady state — substituting the
-    # current row's (always-recent) gid would hold every busy bucket's
-    # gate closed forever.
-    pos_lo = _p32(pos)[:, 0]
-    pos_b = pos_lo[b_c]
-    slot = slot0.astype(jnp.int32) + ((pos_b + rank) % depth)
-    occupied = keep & (pos_b + rank >= depth)
-    gid = jnp.asarray(gid, jnp.int64)
-    old_gid = entries[jnp.where(keep, slot, 0)]
-    wmv = jnp.where(occupied, old_gid, gid)
-    # Coarse-ceil gid war (one vectorized i32 scatter-max; see
-    # _war_max_gid_coarse): overstates by < 2^wm_shift against the
-    # gate's ONE-ring margin (trust iff wm < write_pos - capacity;
-    # the 4x figure elsewhere in this docstring is bucket-coverage
-    # sizing, not gate slack).
-    wm = _war_max_gid_coarse(wm, oob_b, wmv,
-                             occupied | (valid & ~keep), wm_shift)
-    entries = _uset(entries, slot, gid, keep)
-    pos = pos + cnt.astype(pos.dtype)
-    return entries, pos, wm
+    return entries, pos, wm, key_tab, key_wm, ann_poison, n_drops
 
 
 def _span_host_range(ann_svc, ann_span_idx, valid_a, n_spans: int):
@@ -1535,14 +1669,13 @@ def poison_index_trust(state: "StoreState") -> "StoreState":
     store's remaining lifetime, which is exactly the pre-index behavior
     the snapshot was taken under."""
     big = jnp.int64(1) << 60
-    upd = {}
-    for fam in ("cand", "tr"):
-        pos = getattr(state, f"{fam}_pos")
-        wm = getattr(state, f"{fam}_wm")
-        # Explicit i64 (a legacy snapshot may restore other dtypes).
-        upd[f"{fam}_pos"] = jnp.full(pos.shape, big, jnp.int64)
-        upd[f"{fam}_wm"] = jnp.full(wm.shape, I64_MAX, jnp.int64)
-    return state.replace(**upd)
+    # One unified cursor/watermark pair covers every family now
+    # (candidate prefix + trace suffix of the shared arena). Explicit
+    # i64 (a legacy snapshot may restore other dtypes).
+    return state.replace(
+        cand_pos=jnp.full(state.cand_pos.shape, big, jnp.int64),
+        cand_wm=jnp.full(state.cand_wm.shape, I64_MAX, jnp.int64),
+    )
 
 
 def poison_ann_trust(state: "StoreState") -> "StoreState":
@@ -1802,9 +1935,9 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     # are shared with the presence/top-annotation updates further down)
     n_key_drops = jnp.int64(0)
     if c.use_index:
-        lay, _, _ = c.cand_layout
+        lay, _, _ = c.idx_layout
         # Coarse-war granularity for ALL the gid watermarks in this
-        # step (ann_poison, key_wm, tr_wm): overstate by at most
+        # step (ann_poison, key_wm, the trace-segment wm): overstate by at most
         # capacity / 2^_WM_COARSE_FRAC_BITS — a sub-percent slice of
         # each gate's >= 1-ring trust margin (gates trust iff
         # wm < write_pos - capacity, and displaced entries are
@@ -1866,9 +1999,6 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         # annotation fast paths until the span is evicted (see
         # StoreState.ann_poison).
         mid = a_idx_ok & (a_host != h1) & (a_host != h2)
-        upd["ann_poison"] = _war_max_gid_coarse(
-            state.ann_poison, a_host, span_gid_of_ann, mid, wm_shift
-        )
         v_ok = (
             mask_a & (b.ann_value_id >= FIRST_USER_ANNOTATION_ID)
             & (b.ann_value_id < jnp.int32(1 << 30))
@@ -1911,39 +2041,38 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         fams = [f for f, _ in segments]
         assert (fams[0] == StoreConfig.CAND_SVC
                 and StoreConfig.CAND_SVC not in fams[1:]), fams
+        n_cand_rows = sum(p[0].shape[0] for _, p in segments)
+        # Trace-membership families trail the candidate segments in the
+        # SAME unified concatenation: row gids bucketed by trace-id
+        # hash, one sub-family per ring (whole-trace fetch + durations).
+        # Verify carries the trace mix, ts the row's last_ts — the
+        # arena rows are uniform (gid, verify, ts) triples.
+        tb = _bucket_of(_mixb([b.trace_id]), c.trace_buckets)
+        tmix = _verify_of(_mixb([b.trace_id]))
+        NC = StoreConfig.N_CAND_FAMILIES
+        segments.append(seg(
+            NC + StoreConfig.TR_SPAN, tb, gids, tmix, b.ts_last, mask
+        ))
+        segments.append(seg(
+            NC + StoreConfig.TR_ANN, tb[b.ann_span_idx], a_gids,
+            tmix[b.ann_span_idx], ts_a, mask_a,
+        ))
+        segments.append(seg(
+            NC + StoreConfig.TR_BANN, tb[b.bann_span_idx], bb_gids,
+            tmix[b.bann_span_idx], b.ts_last[b.bann_span_idx], mask_b,
+        ))
         cat = [jnp.concatenate(parts)
                for parts in zip(*(p for _, p in segments))]
         (upd["cand_idx"], upd["cand_pos"], upd["cand_wm"],
-         upd["key_tab"], upd["key_wm"], n_key_drops) = _index_write(
+         upd["key_tab"], upd["key_wm"], upd["ann_poison"],
+         n_key_drops) = _index_write(
             state.cand_idx, state.cand_pos, state.cand_wm,
-            state.key_tab, state.key_wm, *cat,
+            state.key_tab, state.key_wm, state.ann_poison, *cat,
             keyed_from=segments[0][1][0].shape[0],
-            wm_shift=wm_shift,
-        )
-        # Trace-membership family: row gids bucketed by trace-id hash,
-        # one sub-family per ring (whole-trace fetch + durations).
-        tlay, _, _ = c.trace_layout
-        tb = _bucket_of(_mixb([b.trace_id]), c.trace_buckets)
-
-        def tseg(fam, local_bucket, gid, ok):
-            b_base, s_base, n_b, depth = tlay[fam]
-            lb = jnp.clip(local_bucket, 0, n_b - 1)
-            return (
-                lb.astype(jnp.int32) + jnp.int32(b_base),
-                lb.astype(jnp.int64) * depth + jnp.int64(s_base),
-                jnp.full(lb.shape[0], depth, jnp.int32),
-                jnp.asarray(gid, jnp.int64),
-                ok,
-            )
-
-        tcat = [jnp.concatenate(parts) for parts in zip(
-            tseg(StoreConfig.TR_SPAN, tb, gids, mask),
-            tseg(StoreConfig.TR_ANN, tb[b.ann_span_idx], a_gids, mask_a),
-            tseg(StoreConfig.TR_BANN, tb[b.bann_span_idx], bb_gids,
-                 mask_b),
-        )]
-        upd["tr_idx"], upd["tr_pos"], upd["tr_wm"] = _gid_index_write(
-            state.tr_idx, state.tr_pos, state.tr_wm, *tcat,
+            n_cand_rows=n_cand_rows,
+            n_cand_buckets=c.cand_layout[1],
+            poison_bucket=a_host, poison_gid=span_gid_of_ann,
+            poison_ok=mid,
             wm_shift=wm_shift,
         )
 
@@ -2557,7 +2686,9 @@ def _iq_durations_impl(entries, pos, wm, trace_id, row_gid, ts_first,
     qb = jnp.int32(b_base) + lb
     rows = (jnp.int32(s_base) + lb[:, None] * depth
             + jnp.arange(depth, dtype=jnp.int32)[None, :])
-    gid = entries[rows.reshape(-1)].reshape(nq, depth)
+    # Unified arena rows are (gid, verify, ts) triples; the gid column
+    # rides the contiguous [n, 3] row gather (the cheap shape class).
+    gid = entries[rows.reshape(-1), 0].reshape(nq, depth)
     slot = jnp.clip((gid % capacity).astype(jnp.int32), 0, capacity - 1)
     live = (gid >= 0) & (row_gid[slot] == gid)
     match = live & (trace_id[slot] == sorted_qids[:, None])
@@ -2585,7 +2716,7 @@ def iquery_durations(state: StoreState, sorted_qids):
     c = state.config
     tlay, _, _ = c.trace_layout
     return _iq_durations_impl(
-        state.tr_idx, state.tr_pos, state.tr_wm,
+        state.cand_idx, state.cand_pos, state.cand_wm,
         state.trace_id, state.row_gid, state.ts_first, state.ts_last,
         state.write_pos, c.capacity, tlay[StoreConfig.TR_SPAN],
         sorted_qids,
@@ -2613,7 +2744,7 @@ def _iq_gather_impl(
         qb = jnp.int32(b_base) + lb
         rows = (jnp.int32(s_base) + lb[:, None] * depth
                 + jnp.arange(depth, dtype=jnp.int32)[None, :])
-        gid = tr_entries[rows.reshape(-1)].reshape(nq, depth)
+        gid = tr_entries[rows.reshape(-1), 0].reshape(nq, depth)
         gate = (tr_pos[qb] <= depth) | (tr_wm[qb] < ring_wp - ring_cap)
         return gid, gate.all()
 
@@ -2677,7 +2808,7 @@ def iquery_gather_trace_rows(
                tlay[StoreConfig.TR_SPAN], tlay[StoreConfig.TR_ANN],
                tlay[StoreConfig.TR_BANN], k_spans, k_anns, k_banns)
     return _iq_gather_impl(
-        state.tr_idx, state.tr_pos, state.tr_wm,
+        state.cand_idx, state.cand_pos, state.cand_wm,
         tuple(getattr(state, col) for col in SPAN_MAT_COLS),
         tuple(getattr(state, col) for col in ANN_MAT_COLS),
         tuple(getattr(state, col) for col in BANN_MAT_COLS),
