@@ -280,6 +280,34 @@ def _hlo_stats(jitfn, *args):
         return f"hlo stats unavailable: {e!r}"
 
 
+def _telemetry_block(store) -> dict:
+    """Per-stage telemetry for the BENCH json: the store's device
+    counter block plus every non-empty latency sketch registered in the
+    process registry (stage p50/p99 summaries)."""
+    from zipkin_tpu import obs
+
+    out = {}
+    cb = getattr(store, "counter_block", None)
+    if callable(cb):
+        try:
+            out["counter_block"] = cb()
+        except Exception as e:  # telemetry must never sink a bench
+            out["counter_block_error"] = str(e)
+    sketches = {}
+    for m in obs.default_registry().collect():
+        if isinstance(m, obs.LatencySketch):
+            items = ([(m.name, m)] if not m.labelnames else [
+                (f"{m.name}{dict(labels)}", child)
+                for labels, child in m._child_items()
+            ])
+            for name, sk in items:
+                if sk.count:
+                    sketches[name] = sk.snapshot()
+    if sketches:
+        out["sketches"] = sketches
+    return out
+
+
 def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
                      n_services: int = 1024, batch_traces: int = 16384,
                      use_pallas: bool = False):
@@ -378,6 +406,11 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
         "chain": chain,
         "archive_runs": archive_runs,
         "use_pallas": use_pallas,
+        # Per-stage telemetry: the device counter block (one fused
+        # fetch — ring occupancy/laps, poison census, ingest counters)
+        # rides the BENCH json so remote runs surface the same
+        # observables /metrics serves live (docs/OBSERVABILITY.md).
+        "telemetry": _telemetry_block(store),
     }
     return store, stats
 

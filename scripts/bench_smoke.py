@@ -38,13 +38,12 @@ def _count_ops(stablehlo_text: str) -> dict:
     r5 split-design baseline at the smoke shapes: 101 scatters /
     6 sorts / 80 gathers; the r6 unified arena ships 95 / 5 / 79 (and
     moves the exact candidate-ts watermark war behind a lax.cond that
-    real traffic never executes)."""
-    import re
+    real traffic never executes). One shared counter (dev.
+    stablehlo_op_census) backs this gate AND the runtime
+    TpuSpanStore.step_census observable, so they can never drift."""
+    from zipkin_tpu.store.device import stablehlo_op_census
 
-    return {
-        op: len(re.findall(rf'"stablehlo\.{op}"', stablehlo_text))
-        for op in ("scatter", "gather", "sort")
-    }
+    return stablehlo_op_census(stablehlo_text)
 
 
 def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
@@ -75,13 +74,20 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         ))
 
     # Op-count census of the fused step's lowering (the compile below
-    # shares the jit cache, so this adds a trace, not a compile).
+    # shares the jit cache, so this adds a trace, not a compile). The
+    # telemetry counter block must stay a pure read: its lowering may
+    # contain NO scatter/sort, and the step census is taken with the
+    # obs layer fully wired — together they prove the device counter
+    # fetch adds zero passes (tests/test_bench_smoke.py gates both).
     state = store.state
     ops = _count_ops(dev.ingest_step.lower(state, dbs[0]).as_text())
+    cb_ops = _count_ops(dev.counter_block.lower(state).as_text())
 
     # Fused-ingest timing (compile excluded: first step warms). The
     # warm-up step's spans are excluded from the rate — spans_seen is
     # snapshotted before t0 so the numerator matches the timed window.
+    # The timed loop stays ASYNC (dispatch pipelining included), the
+    # r6 methodology — ingest_spans_per_s remains trend-comparable.
     state = dev.ingest_step(state, dbs[0])
     import jax
 
@@ -92,6 +98,19 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
     seen = int(jax.device_get(state.counters["spans_seen"]))
     dt = time.perf_counter() - t0
     total = seen - warm
+    # Telemetry sketch pass: a SEPARATE loop, synced per step
+    # (device_get is the reliable barrier), so the per-step p50/p99
+    # never perturbs the throughput window above.
+    from zipkin_tpu import obs
+
+    step_sketch = obs.LatencySketch(
+        "bench_ingest_step_seconds", "per-step wall time")
+    for db in dbs:
+        ts_step = time.perf_counter()
+        state = dev.ingest_step(state, db)
+        jax.device_get(state.write_pos)
+        step_sketch.observe(time.perf_counter() - ts_step)
+    seen = int(jax.device_get(state.counters["spans_seen"]))
     store.adopt_state(state, spans_written=seen)
 
     # Batched-query scaling: k singular launches vs one multi launch.
@@ -122,6 +141,11 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         [(i.trace_id, i.timestamp) for i in ids] for ids in want
     ]
 
+    step_ms = {
+        k: (round(v * 1e3, 3) if k in ("sum", "mean", "stddev", "p50",
+                                       "p99") and v == v else v)
+        for k, v in step_sketch.snapshot().items()
+    }
     return {
         "metric": "bench_smoke",
         "spans": total,
@@ -130,6 +154,12 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         "step_scatters": ops["scatter"],
         "step_gathers": ops["gather"],
         "step_sorts": ops["sort"],
+        "telemetry": {
+            "counter_block": store.counter_block(),
+            "counter_block_scatters": cb_ops["scatter"],
+            "counter_block_sorts": cb_ops["sort"],
+            "ingest_step_ms": step_ms,
+        },
         "multi_query": {
             "k": k_queries,
             "serial_ms": round(serial_s * 1e3, 2),

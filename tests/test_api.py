@@ -133,8 +133,18 @@ class TestRoutes:
 
     def test_health_and_metrics(self, app):
         assert app.handle("GET", "/health", {})[0] == 200
-        status, metrics = app.handle("GET", "/metrics", {})
+        # Default form is Prometheus text exposition ...
+        status, payload = app.handle("GET", "/metrics", {})
+        from zipkin_tpu.api.server import RawResponse
+
+        assert status == 200 and isinstance(payload, RawResponse)
+        assert payload.content_type.startswith("text/plain")
+        assert b"zipkin_queue_depth" in payload.body
+        # ... the legacy JSON dict stayed at ?format=json.
+        status, metrics = app.handle("GET", "/metrics",
+                                     {"format": "json"})
         assert status == 200 and "collector.queue_size" in metrics
+        assert "store.spans_stored" in metrics
 
     def test_unknown_404(self, app):
         assert app.handle("GET", "/api/nope", {})[0] == 404
@@ -215,12 +225,12 @@ class TestSelfTracing:
         status, _ = api.handle("GET", "/api/services", {})
         assert status == 200
         collector.flush()
-        assert "zipkin-query" in store.get_all_service_names()
-        names = store.get_span_names("zipkin-query")
+        assert "zipkin-tpu" in store.get_all_service_names()
+        names = store.get_span_names("zipkin-tpu")
         assert "get /api/services" in names
         # The self-trace is queryable through the API itself.
         status, body = api.handle(
-            "GET", "/api/query", {"serviceName": "zipkin-query"})
+            "GET", "/api/query", {"serviceName": "zipkin-tpu"})
         collector.flush()
         assert status == 200 and body["traceIds"]
 
@@ -265,7 +275,7 @@ class TestSelfTracing:
         api.handle("POST", "/api/spans", {}, b"[]")
         api.handle("GET", "/health", {})
         collector.flush()
-        assert "zipkin-query" not in store.get_all_service_names()
+        assert "zipkin-tpu" not in store.get_all_service_names()
 
 
 def test_negative_trace_id_roundtrip_through_hex_api():
@@ -341,7 +351,7 @@ class TestStrictJsonEveryRoute:
     # (method, path, params, body) — every JSON route the server maps.
     ROUTES = [
         ("GET", "/health", {}, b""),
-        ("GET", "/metrics", {}, b""),
+        ("GET", "/metrics", {"format": "json"}, b""),
         ("GET", "/api/services", {}, b""),
         ("GET", "/api/spans", {"serviceName": "api"}, b""),
         ("GET", "/api/top_annotations", {"serviceName": "api"}, b""),

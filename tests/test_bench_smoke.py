@@ -32,9 +32,22 @@ def test_bench_smoke_json_and_op_ceilings():
     rec = json.loads(line)  # exactly one JSON line
     assert rec["metric"] == "bench_smoke"
     assert rec["spans"] > 0 and rec["ingest_spans_per_s"] > 0
-    # The index-family step-count gate.
+    # The index-family step-count gate — measured WITH telemetry wired
+    # (the store registers its obs metrics and the counter block is
+    # fetched), so a device counter fetch that grew the step would
+    # trip here.
     assert rec["step_scatters"] <= MAX_STEP_SCATTERS, rec
     assert rec["step_sorts"] <= MAX_STEP_SORTS, rec
+    # The telemetry counter block itself must lower as a pure read.
+    tel = rec["telemetry"]
+    assert tel["counter_block_scatters"] == 0
+    assert tel["counter_block_sorts"] == 0
+    # spans_seen counts the warm-up step too, so >= the timed spans.
+    assert tel["counter_block"]["spans_seen"] >= rec["spans"]
+    assert tel["counter_block"]["ring_occupancy"] > 0
+    # Per-stage sketch summary rode along (p50/p99 in ms).
+    assert tel["ingest_step_ms"]["count"] > 0
+    assert tel["ingest_step_ms"]["p50"] > 0
     # Batched-query phase ran and agreed with serial execution.
     mq = rec["multi_query"]
     assert mq["k"] == 4 and mq["identical"] is True

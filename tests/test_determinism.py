@@ -98,7 +98,8 @@ def test_control_loop_reads_device_counters():
     collector.control_tick(now_s=t0)
     # Poison the host counter: if control_tick read it, the flow would be
     # absurd and the rate would not follow the device counter's story.
-    collector.spans_stored = 10**9
+    # (spans_stored is a registry-backed property now; poke the counter.)
+    collector._c_stored.inc(10**9)
     n_ticks = 6
     per_tick = max(1, len(spans) // n_ticks)
     rate_before = collector.sampler.rate

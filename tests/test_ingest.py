@@ -140,6 +140,57 @@ class TestItemQueue:
         with pytest.raises(QueueFullException):
             q.add(99)
 
+    def test_depth_and_drop_gauges_under_enqueue_pressure(self):
+        """The telemetry registry's queue depth gauge and rejected
+        counter track a full buffer exactly (drop-rate observable)."""
+        from zipkin_tpu import obs
+
+        reg = obs.Registry()
+        gate = threading.Event()
+        q = ItemQueue(lambda _: gate.wait(10), max_size=2,
+                      concurrency=1, registry=reg)
+        try:
+            q.add("a")  # worker picks this up and blocks
+            deadline = time.monotonic() + 5
+            while q.active_workers < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            for _ in range(2):  # fill behind the blocked worker
+                try:
+                    q.add("b")
+                except QueueFullException:
+                    break
+            rejections = 0
+            for _ in range(3):
+                try:
+                    q.add("c")
+                except QueueFullException:
+                    rejections += 1
+            assert rejections >= 1
+            d = reg.as_dict()
+            assert d["zipkin_queue_depth"] >= 2
+            assert d["zipkin_queue_rejected_total"] == rejections
+            assert d["zipkin_queue_active_workers"] == 1
+        finally:
+            gate.set()
+            q.close(timeout=5)
+        done = reg.as_dict()
+        assert done["zipkin_queue_processed_total"] == \
+            done["zipkin_queue_enqueued_total"]
+
+    def test_concurrent_worker_counters_exact(self):
+        """processed/errors ride locked registry counters now; the old
+        unlocked += lost increments under concurrent workers."""
+        def maybe_boom(i):
+            if i % 10 == 0:
+                raise RuntimeError("boom")
+
+        q = ItemQueue(maybe_boom, max_size=500, concurrency=8)
+        for i in range(400):
+            q.add(i)
+        q.join()
+        assert q.errors == 40
+        assert q.processed == 360
+
 
 class TestScribeReceiver:
     def test_decode_and_process(self):

@@ -1,0 +1,415 @@
+"""Telemetry layer tests: registry semantics, Prometheus exposition,
+latency sketches (moments + log-histogram agreement), the device
+counter block, per-route API latency, the profiler endpoint, and
+collector ingest-step self-tracing."""
+
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from zipkin_tpu import obs
+from zipkin_tpu.api import ApiServer
+from zipkin_tpu.ingest.collector import Collector
+from zipkin_tpu.models.span import Annotation, Endpoint, Span
+from zipkin_tpu.query.service import QueryService
+from zipkin_tpu.store.memory import InMemorySpanStore
+
+EP = Endpoint(0x01010101, 80, "svc")
+
+
+def span(tid, sid=1, ts=100):
+    return Span(tid, "op", sid, None, (
+        Annotation(ts, "sr", EP), Annotation(ts + 10, "ss", EP),
+    ), ())
+
+
+class TestRegistry:
+    def test_counter_monotonic_and_locked(self):
+        r = obs.Registry()
+        c = r.register(obs.Counter("t_total", "h"))
+        threads = [
+            threading.Thread(target=lambda: [c.inc() for _ in range(1000)])
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_fn_and_set(self):
+        g = obs.Gauge("g", "h", fn=lambda: 41)
+        assert g.value == 41
+        g.set(5)
+        assert g.value == 5
+
+    def test_reregister_replaces(self):
+        r = obs.Registry()
+        r.register(obs.Counter("x", "h")).inc(3)
+        c2 = r.register(obs.Counter("x", "h"))
+        assert r.get("x") is c2 and c2.value == 0
+
+    def test_labels_children(self):
+        c = obs.Counter("req_total", "h", labelnames=("route",))
+        c.labels(route="/a").inc(2)
+        c.labels(route="/b").inc()
+        assert c.labels(route="/a").value == 2
+        with pytest.raises(ValueError):
+            c.labels(nope="x")
+
+    def test_sketch_quantiles_and_moments(self):
+        h = obs.LatencySketch("lat_seconds", "h")
+        vals = np.random.default_rng(7).uniform(1e-4, 1.0, 5000)
+        for v in vals:
+            h.observe(float(v))
+        p50, p99 = h.quantile_values((0.5, 0.99))
+        # DDSketch relative-accuracy guarantee (alpha=1%, small slack
+        # for the discrete rank step).
+        assert abs(p50 - np.quantile(vals, 0.5)) / p50 < 0.05
+        assert abs(p99 - np.quantile(vals, 0.99)) / p99 < 0.05
+        snap = h.snapshot()
+        assert snap["count"] == 5000
+        assert abs(snap["mean"] - vals.mean()) < 1e-6
+        assert abs(snap["stddev"] - vals.std()) < 1e-6
+
+    def test_sketch_merge(self):
+        a = obs.LatencySketch("m", "h")
+        b = obs.LatencySketch("m", "h")
+        for v in (0.1, 0.2):
+            a.observe(v)
+        for v in (0.3, 0.4):
+            b.observe(v)
+        a.merge(b)
+        assert a.count == 4
+        assert abs(a.snapshot()["mean"] - 0.25) < 1e-9
+
+
+class TestPrometheusText:
+    def _registry(self):
+        r = obs.Registry()
+        r.register(obs.Counter("z_total", "a counter")).inc(2)
+        r.register(obs.Gauge("z_gauge", "a gauge", fn=lambda: 1.5))
+        h = r.register(obs.LatencySketch("z_seconds", "a summary"))
+        h.observe(0.25)
+        return r
+
+    def test_type_and_help_lines(self):
+        text = self._registry().render_text()
+        assert "# TYPE z_total counter\n" in text
+        assert "# TYPE z_gauge gauge\n" in text
+        assert "# TYPE z_seconds summary\n" in text
+        assert "# HELP z_total a counter\n" in text
+        assert "\nz_total 2\n" in text
+        assert "\nz_gauge 1.5\n" in text
+        assert 'z_seconds{quantile="0.5"}' in text
+        assert 'z_seconds{quantile="0.99"}' in text
+        assert "\nz_seconds_count 1\n" in text
+
+    def test_label_escaping(self):
+        r = obs.Registry()
+        c = r.register(obs.Counter("esc_total", "h",
+                                   labelnames=("route",)))
+        c.labels(route='we"ird\\path\nx').inc()
+        text = r.render_text()
+        assert 'esc_total{route="we\\"ird\\\\path\\nx"} 1' in text
+
+    def test_empty_sketch_renders_nan(self):
+        r = obs.Registry()
+        r.register(obs.LatencySketch("never_seconds", "h"))
+        text = r.render_text()
+        assert 'never_seconds{quantile="0.5"} NaN' in text
+        assert "never_seconds_count 0" in text
+
+
+class TestApiMetricsSurface:
+    """Acceptance shape: /metrics serves valid Prometheus text covering
+    every pipeline stage with latency quantiles, and stays monotonic
+    across scrapes."""
+
+    def _app(self):
+        reg = obs.Registry()
+        store = InMemorySpanStore()
+        collector = Collector(store, concurrency=2, registry=reg)
+        api = ApiServer(QueryService(store), collector, registry=reg)
+        return store, collector, api, reg
+
+    def test_all_five_stages_present(self):
+        store, collector, api, reg = self._app()
+        collector.accept([span(1)])
+        collector.flush()
+        api.handle("GET", "/api/services", {})
+        status, payload = api.handle("GET", "/metrics", {})
+        assert status == 200
+        text = payload.body.decode()
+        stage_markers = {
+            "queue": "zipkin_queue_depth",
+            "collector": "zipkin_collector_spans_stored_total",
+            "store": 'zipkin_store_counter{name="spans_stored"}',
+            "query": 'zipkin_api_request_seconds{route="/api/services"'
+                     ',quantile="0.99"}',
+            "sampler": "zipkin_sampler_rate",
+        }
+        for stage, marker in stage_markers.items():
+            assert marker in text, (stage, text)
+        # >= 12 distinct metric families exposed.
+        families = {
+            line.split()[2] for line in text.splitlines()
+            if line.startswith("# TYPE")
+        }
+        assert len(families) >= 12, sorted(families)
+        # p50 AND p99 lines exist for the latency summaries.
+        assert 'quantile="0.5"' in text and 'quantile="0.99"' in text
+
+    def test_counters_monotonic_across_requests(self):
+        store, collector, api, reg = self._app()
+
+        def scrape():
+            _, payload = api.handle("GET", "/metrics", {})
+            out = {}
+            for line in payload.body.decode().splitlines():
+                if line.startswith("#"):
+                    continue
+                k, _, v = line.rpartition(" ")
+                if v not in ("NaN", "+Inf", "-Inf"):
+                    out[k] = float(v)
+            return out
+
+        first = scrape()
+        for i in range(3):
+            collector.accept([span(10 + i)])
+        collector.flush()
+        api.handle("GET", "/api/services", {})
+        second = scrape()
+        counters = [
+            k for k in first
+            if k.endswith("_total") or k.endswith("_count")
+        ]
+        assert counters
+        for k in counters:
+            assert second.get(k, 0) >= first[k], k
+        assert (second["zipkin_collector_spans_stored_total"]
+                >= first["zipkin_collector_spans_stored_total"] + 3)
+
+    def test_json_form_unchanged(self):
+        store, collector, api, reg = self._app()
+        status, body = api.handle("GET", "/metrics", {"format": "json"})
+        assert status == 200
+        assert "collector.queue_size" in body
+        json.dumps(body)  # still a plain JSON dict
+
+    def test_route_label_normalization(self):
+        from zipkin_tpu.api.server import _route_label
+
+        assert _route_label("/api/trace/deadbeef") == "/api/trace/{id}"
+        assert _route_label("/api/pin/1f/true") == "/api/pin/{id}"
+        assert _route_label("/api/query") == "/api/query"
+        assert _route_label("/some/scanner/path") == "other"
+
+    def test_profile_endpoint(self):
+        store, collector, api, reg = self._app()
+        status, body = api.handle("POST", "/debug/profile",
+                                  {"seconds": "0.05"})
+        # 200 with a trace dir when the backend can trace, 503 when the
+        # profiler is unavailable in this environment — never a crash.
+        assert status in (200, 503), body
+        if status == 200:
+            import os
+
+            assert os.path.isdir(body["profileDir"])
+        status2, body2 = api.handle("POST", "/debug/profile",
+                                    {"seconds": "nope"})
+        assert status2 == 400
+
+
+class TestCollectorTelemetry:
+    def test_threaded_failure_counters_exact(self):
+        """Failure-path counters must not lose increments under
+        concurrent submitters (the old dict read-modify-write hazard)."""
+        reg = obs.Registry()
+        store = InMemorySpanStore()
+        collector = Collector(store, concurrency=4, registry=reg)
+        n_threads, n_each = 8, 50
+
+        def slam():
+            for _ in range(n_each):
+                collector._decode_segments_slow([b"\x00garbage"])
+
+        threads = [threading.Thread(target=slam) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert collector.bad_payloads == n_threads * n_each
+
+    def test_batch_and_latency_sketches_fill(self):
+        reg = obs.Registry()
+        store = InMemorySpanStore()
+        collector = Collector(store, concurrency=1, registry=reg)
+        for i in range(4):
+            collector.accept([span(i + 1), span(i + 1, sid=2)])
+        collector.flush()
+        d = reg.as_dict()
+        assert d["zipkin_collector_batch_spans_count"] == 4
+        assert d['zipkin_collector_batch_spans{quantile="0.5"}'] == \
+            pytest.approx(2.0, rel=0.05)
+        assert d["zipkin_collector_write_seconds_count"] == 4
+
+    def test_ingest_self_trace_spans_reach_store(self):
+        """self_trace=True records one zipkin-tpu span per ingest step,
+        written straight to the store — and never recursively."""
+        reg = obs.Registry()
+        store = InMemorySpanStore()
+        collector = Collector(store, concurrency=1, registry=reg,
+                              self_trace=True)
+        collector.accept([span(42)])
+        collector.flush()
+        assert "zipkin-tpu" in store.get_all_service_names()
+        assert "collector ingest" in store.get_span_names("zipkin-tpu")
+        # Exactly one self span for one processed batch (no feedback).
+        self_spans = [
+            s for s in store.spans
+            if "zipkin-tpu" in s.service_names
+        ]
+        assert len(self_spans) == 1
+
+
+class TestSelfTraceRoundTrip:
+    def test_api_request_trace_queryable_by_id(self):
+        """Acceptance: the self-trace span for an API round trip is
+        fetchable through /api/trace/{id} using the echoed trace id."""
+        reg = obs.Registry()
+        store = InMemorySpanStore()
+        collector = Collector(store, concurrency=1, registry=reg)
+        api = ApiServer(QueryService(store), collector, registry=reg)
+        resp_headers = []
+        api.handle("GET", "/api/services", {},
+                   response_headers=resp_headers)
+        tid_hex = dict(resp_headers)["X-B3-TraceId"]
+        collector.flush()
+        status, body = api.handle("GET", f"/api/trace/{tid_hex}", {})
+        assert status == 200
+        assert body[0]["annotations"][0]["endpoint"]["serviceName"] == \
+            "zipkin-tpu"
+
+
+class TestDeviceCounterBlock:
+    def _store(self):
+        from zipkin_tpu.store.device import StoreConfig
+        from zipkin_tpu.store.tpu import TpuSpanStore
+
+        return TpuSpanStore(StoreConfig(
+            capacity=1 << 10, ann_capacity=1 << 12,
+            bann_capacity=1 << 11, max_services=32, max_span_names=128,
+            max_annotation_values=256, max_binary_keys=64,
+            cms_width=1 << 10, hll_p=8, quantile_buckets=256,
+        ), registry=obs.Registry())
+
+    def test_block_fields_and_memo(self):
+        from zipkin_tpu.store import device as dev
+
+        store = self._store()
+        store.apply([span(1), span(2)])
+        blk = store.counter_block()
+        assert set(blk) == set(dev.COUNTER_BLOCK_FIELDS)
+        assert blk["spans_seen"] == 2
+        assert blk["ring_occupancy"] == 2 and blk["ring_laps"] == 0
+        assert blk["batches"] == 1
+        # Memoized between ingest steps: same dict object back.
+        assert store.counter_block() is blk
+        store.apply([span(3)])
+        blk2 = store.counter_block()
+        assert blk2 is not blk and blk2["spans_seen"] == 3
+        # counters() keeps every legacy key + the host guards.
+        c = store.counters()
+        for key in ("spans_seen", "anns_seen", "banns_seen", "batches",
+                    "key_claim_drops", "sweeps", "index_hits",
+                    "index_scan_fallbacks", "anns_truncated",
+                    "banns_truncated", "ring_occupancy"):
+            assert key in c, key
+
+    def test_step_census_memoized(self):
+        store = self._store()
+        census = store.step_census(n_spans=64, n_anns=128, n_banns=64)
+        assert census["scatter"] > 0 and census["sort"] > 0
+        assert store.step_census(n_spans=64, n_anns=128,
+                                 n_banns=64) is census
+
+    def test_counter_block_lowering_has_no_scatters(self):
+        """The telemetry fetch is a pure read: no scatter/sort ops may
+        ever lower from it (the zero-extra-passes design claim)."""
+        import re
+
+        from zipkin_tpu.store import device as dev
+
+        store = self._store()
+        text = dev.counter_block.lower(store.state).as_text()
+        for op in ("scatter", "sort"):
+            assert not re.findall(rf'"stablehlo\.{op}"', text), op
+
+    def test_ingest_latency_sketch_fills(self):
+        reg = obs.Registry()
+        from zipkin_tpu.store.device import StoreConfig
+        from zipkin_tpu.store.tpu import TpuSpanStore
+
+        store = TpuSpanStore(StoreConfig(
+            capacity=1 << 10, ann_capacity=1 << 12,
+            bann_capacity=1 << 11, max_services=32, max_span_names=128,
+            max_annotation_values=256, max_binary_keys=64,
+            cms_width=1 << 10, hll_p=8, quantile_buckets=256,
+        ), registry=reg)
+        store.apply([span(9)])
+        d = reg.as_dict()
+        assert d["zipkin_store_ingest_launches_total"] == 1
+        assert d["zipkin_store_ingest_step_seconds_count"] == 1
+
+
+class TestSuspectStore:
+    def test_slab_timeout_marks_store_suspect(self, tmp_path,
+                                              monkeypatch):
+        """ADVICE r5 regression: a slab-save timeout (slow fake device)
+        must flag the store so donating ingest and the next save refuse
+        to race the orphaned reader; joining the orphan clears it."""
+        import jax
+
+        from zipkin_tpu import checkpoint
+        from zipkin_tpu.store.base import StoreSuspectError
+
+        store = TestDeviceCounterBlock()._store()
+        store.apply([span(1)])
+        real_get = jax.device_get
+        release = threading.Event()
+
+        def slow_get(x):
+            # Only the checkpoint's abandonable fetch threads are
+            # daemons here; the main thread's gets pass through.
+            if threading.current_thread().daemon and not release.is_set():
+                release.wait(30)
+            return real_get(x)
+
+        with monkeypatch.context() as m:
+            m.setattr(jax, "device_get", slow_get)
+            with pytest.raises(TimeoutError):
+                checkpoint.save(store, str(tmp_path / "ckpt"),
+                                chunk_deadline_s=0.3, slab_retries=0)
+        assert store.suspect
+        # Donating writes refuse while the orphan may still read state.
+        with pytest.raises(StoreSuspectError):
+            store.apply([span(2)])
+        # The next save refuses too (it would cut a new snapshot over
+        # buffers the orphan still reads).
+        with pytest.raises(StoreSuspectError):
+            checkpoint.save(store, str(tmp_path / "ckpt2"))
+        # Un-wedge the fake device; joining the orphan clears the flag.
+        release.set()
+        store.ensure_writable(wait_s=10.0)
+        assert not store.suspect
+        store.apply([span(2)])
+        assert store.counter_block()["spans_seen"] == 2
+        checkpoint.save(store, str(tmp_path / "ckpt3"))
+        restored = checkpoint.load(str(tmp_path / "ckpt3"))
+        assert restored.counter_block()["spans_seen"] == 2

@@ -12,10 +12,12 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qsl, urlparse
 
+from zipkin_tpu import obs
 from zipkin_tpu.api.query_extractor import extract_query
 from zipkin_tpu.ingest.collector import Collector
 from zipkin_tpu.ingest.receiver import (
@@ -106,10 +108,40 @@ class ApiServer:
     def __init__(self, query: QueryService, collector: Optional[Collector] = None,
                  pin_ttl_s: float = DEFAULT_PIN_TTL_S,
                  self_trace: bool = True,
-                 self_service_name: str = "zipkin-query"):
+                 self_service_name: str = "zipkin-tpu",
+                 registry: Optional[obs.Registry] = None):
         self.query = query
         self.collector = collector
         self.pin_ttl_s = pin_ttl_s
+        self.registry = registry or obs.default_registry()
+        # Query-stage latency sketch: p50/p99 per normalized route
+        # (moments + log-histogram, see obs.LatencySketch).
+        self.request_latency = self.registry.register(obs.LatencySketch(
+            "zipkin_api_request_seconds",
+            "API request handling latency per route",
+            labelnames=("route",)))
+        self.requests_total = self.registry.register(obs.Counter(
+            "zipkin_api_requests_total", "API requests handled",
+            labelnames=("route",)))
+        coal = getattr(query, "coalescer", None)
+        if coal is not None:
+            for attr, help_ in (
+                ("batches", "Coalesced query batches executed"),
+                ("queries", "Trace-id queries served through the "
+                            "coalescer"),
+                ("launches_saved", "Device dispatches removed by "
+                                   "cross-request coalescing"),
+                ("max_batch", "Largest coalesced batch so far"),
+            ):
+                self.registry.register(obs.Gauge(
+                    f"zipkin_query_coalesce_{attr}", help_,
+                    fn=(lambda a=attr: getattr(coal, a))))
+        counters = getattr(query.store, "counters", None)
+        if callable(counters):
+            self.registry.register(obs.CallbackFamily(
+                "zipkin_store_counter",
+                "Store counters (device counter block + host guards)",
+                "name", counters))
         # Self-tracing (SURVEY §5): the query service records a server
         # span per API request into its own collector, continuing any
         # incoming B3 trace — the finagle-zipkin role the reference
@@ -131,6 +163,13 @@ class ApiServer:
         self.json_ingest = (
             JsonReceiver(collector.accept) if collector is not None else None
         )
+        if self.scribe is not None:
+            scribe = self.scribe
+            self.registry.register(obs.CallbackFamily(
+                "zipkin_scribe_entries",
+                "Scribe receiver entry accounting "
+                "(received/ignored/bad/pushed_back)",
+                "result", lambda: dict(scribe.stats)))
         # Runtime-adjustable vars (HttpVar.scala:30 / the old
         # /config/sampleRate endpoint): name → (getter, setter).
         self.vars = {}
@@ -160,6 +199,21 @@ class ApiServer:
                body: bytes = b"", headers: Optional[dict] = None,
                response_headers: Optional[list] = None
                ) -> Tuple[int, object]:
+        t0 = time.perf_counter()
+        try:
+            return self._handle_traced(method, path, params, body,
+                                       headers, response_headers)
+        finally:
+            route = _route_label(path)
+            self.requests_total.labels(route=route).inc()
+            self.request_latency.labels(route=route).observe(
+                time.perf_counter() - t0)
+
+    def _handle_traced(self, method: str, path: str, params: dict,
+                       body: bytes = b"",
+                       headers: Optional[dict] = None,
+                       response_headers: Optional[list] = None
+                       ) -> Tuple[int, object]:
         if not self._should_self_trace(method, path):
             return self._dispatch(method, path, params, body)
         import time as _time
@@ -211,7 +265,16 @@ class ApiServer:
         if path == "/health":
             return 200, {"status": "ok"}
         if path == "/metrics":
-            return 200, self._metrics()
+            # Prometheus text exposition by default; the legacy JSON
+            # dict stays at ?format=json (docs/MIGRATION.md).
+            if params.get("format") == "json":
+                return 200, self._metrics()
+            return 200, RawResponse(
+                "text/plain; version=0.0.4; charset=utf-8",
+                self.registry.render_text().encode("utf-8"),
+            )
+        if method == "POST" and path == "/debug/profile":
+            return self._profile(params)
         if path == "/api/query":
             return self._query(params)
         if path == "/api/services":
@@ -405,6 +468,25 @@ class ApiServer:
         code = self.scribe.log(entries)
         return 200, {"result": code.name}
 
+    def _profile(self, params):
+        """POST /debug/profile?seconds=N — capture a jax.profiler trace
+        for N seconds (this request's thread blocks for the window;
+        ThreadingHTTPServer keeps serving others). Returns the trace
+        directory, viewable with TensorBoard/Perfetto."""
+        from zipkin_tpu.obs import profile as obs_profile
+
+        try:
+            seconds = float(params.get("seconds", "1.0"))
+        except ValueError:
+            return 400, {"error": "seconds must be a number"}
+        try:
+            out_dir, effective = obs_profile.capture(seconds)
+        except obs_profile.ProfilerBusy as e:
+            return 409, {"error": str(e)}
+        except Exception as e:  # backend can't trace → service-level 503
+            return 503, {"error": f"profiler unavailable: {e}"}
+        return 200, {"profileDir": out_dir, "seconds": effective}
+
     def _metrics(self):
         out = {}
         if self.collector is not None:
@@ -431,6 +513,36 @@ class ApiServer:
                 "query.coalesce_max_batch": coal.max_batch,
             })
         return out
+
+
+# Dynamic path segments collapse to {id} so the per-route latency
+# family stays bounded-cardinality; anything unrecognized buckets into
+# "other" (a hostile scanner must not mint one series per probe).
+_ROUTE_ID_RE = re.compile(
+    r"^(/api/(?:trace|get|timeline|combo|is_pinned))/[^/]+$")
+_ROUTE_PIN_RE = re.compile(r"^/api/pin/[^/]+/(?:true|false)$")
+_KNOWN_ROUTES = frozenset((
+    "/", "/index.html", "/traces", "/aggregate", "/health", "/metrics",
+    "/debug/profile", "/api/query", "/api/services", "/api/spans",
+    "/api/v1/spans", "/api/top_annotations", "/api/top_kv_annotations",
+    "/api/quantiles", "/api/dependencies", "/api/traces_exist",
+    "/scribe",
+))
+
+
+def _route_label(path: str) -> str:
+    m = _ROUTE_ID_RE.match(path)
+    if m:
+        return m.group(1) + "/{id}"
+    if _ROUTE_PIN_RE.match(path):
+        return "/api/pin/{id}"
+    if path in _KNOWN_ROUTES:
+        return path
+    if path.startswith("/api/dependencies/"):
+        return "/api/dependencies/{window}"
+    if path.startswith("/vars/"):
+        return "/vars/{name}"
+    return "other"
 
 
 def _parse_trace_id(raw: str) -> int:

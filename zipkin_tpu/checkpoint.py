@@ -127,8 +127,13 @@ def _bounded_get(x, deadline_s: Optional[float]):
     t.start()
     t.join(deadline_s)
     if t.is_alive():
-        raise TimeoutError(
+        err = TimeoutError(
             f"device_get exceeded {deadline_s:.0f}s (wedged transfer?)")
+        # The abandoned thread may keep READING state buffers after the
+        # caller's locks release; carry it so save() can stamp the store
+        # suspect (store.base.SuspectGuard) and later joins can clear it.
+        err.orphan = t
+        raise err
     if "e" in box:
         raise box["e"]
     return box["v"]
@@ -222,6 +227,13 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
     than mixing two cuts). Returns transfer stats (slab count/bytes/
     bandwidth, resumed leaf count)."""
     n_shards = getattr(store, "n", None) if hasattr(store, "states") else None
+    # A PRIOR save's timeout may have left an orphaned transfer thread
+    # still reading the state; a fresh consistent cut must not race it.
+    # Give the orphan a short grace to finish, else refuse
+    # (StoreSuspectError) — the same gate the donating write paths use.
+    ensure = getattr(store, "ensure_writable", None)
+    if ensure is not None and getattr(store, "suspect", False):
+        ensure(wait_s=5.0)
     stats: dict = {"resumed_leaves": 0, "chunked": chunk_deadline_s
                    is not None}
     staging = os.path.abspath(path) + ".staging"
@@ -245,42 +257,49 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
                 leaves[name] = np.asarray(value)
     else:
         # Chunked+resumable path. The read lock covers the whole
-        # gather (consistent cut; writers block). CAVEAT on timeout:
-        # the orphaned transfer thread may still be reading state
-        # buffers after the lock releases — like bench.py's _bounded,
-        # a TimeoutError here means the caller must treat the DEVICE
-        # side as suspect and not resume donating writes until the
-        # process restarts or a fresh probe succeeds; schedule
-        # deadline-bounded saves last (axon tunnel discipline).
-        with store._rw.read():
-            gen = _state_generation(store, n_shards, chunk_deadline_s)
-            if os.path.isdir(staging):
-                try:
-                    with open(os.path.join(staging, _GEN_FILE)) as f:
-                        prior = json.load(f)
-                except (OSError, ValueError):
-                    prior = None
-                if prior != gen:
-                    shutil.rmtree(staging, ignore_errors=True)
-            os.makedirs(staging, exist_ok=True)
-            with open(os.path.join(staging, _GEN_FILE), "w") as f:
-                json.dump(gen, f)
-            state = store.states if n_shards else store.state
-            for name in dev.StoreState._FIELDS:
-                value = getattr(state, name)
-                items = ([(f"counters.{k}", v) for k, v in value.items()]
-                         if name == "counters" else [(name, value)])
-                for key, leaf in items:
-                    dest = os.path.join(staging, key + ".npy")
-                    if os.path.exists(dest):
-                        stats["resumed_leaves"] += 1
-                        continue
-                    host = _fetch_leaf(leaf, chunk_deadline_s,
-                                       slab_retries, stats)
-                    tmp_leaf = dest + ".tmp"
-                    with open(tmp_leaf, "wb") as f:
-                        np.save(f, host, allow_pickle=False)
-                    os.replace(tmp_leaf, dest)
+        # gather (consistent cut; writers block). On timeout the
+        # orphaned transfer thread may still be reading state buffers
+        # after the lock releases, so the store is STAMPED SUSPECT
+        # below (ADVICE r5): donating ingest and the next save refuse
+        # to run (StoreSuspectError) until the orphan is joined —
+        # nothing relies on callers reading a docstring anymore.
+        try:
+            with store._rw.read():
+                gen = _state_generation(store, n_shards,
+                                        chunk_deadline_s)
+                if os.path.isdir(staging):
+                    try:
+                        with open(os.path.join(staging, _GEN_FILE)) as f:
+                            prior = json.load(f)
+                    except (OSError, ValueError):
+                        prior = None
+                    if prior != gen:
+                        shutil.rmtree(staging, ignore_errors=True)
+                os.makedirs(staging, exist_ok=True)
+                with open(os.path.join(staging, _GEN_FILE), "w") as f:
+                    json.dump(gen, f)
+                state = store.states if n_shards else store.state
+                for name in dev.StoreState._FIELDS:
+                    value = getattr(state, name)
+                    items = ([(f"counters.{k}", v)
+                              for k, v in value.items()]
+                             if name == "counters" else [(name, value)])
+                    for key, leaf in items:
+                        dest = os.path.join(staging, key + ".npy")
+                        if os.path.exists(dest):
+                            stats["resumed_leaves"] += 1
+                            continue
+                        host = _fetch_leaf(leaf, chunk_deadline_s,
+                                           slab_retries, stats)
+                        tmp_leaf = dest + ".tmp"
+                        with open(tmp_leaf, "wb") as f:
+                            np.save(f, host, allow_pickle=False)
+                        os.replace(tmp_leaf, dest)
+        except TimeoutError as e:
+            mark = getattr(store, "mark_suspect", None)
+            if mark is not None:
+                mark(getattr(e, "orphan", None))
+            raise
         if stats.get("slab_s"):
             stats["mb_per_s_avg"] = round(
                 stats["bytes"] / 1e6 / stats["slab_s"], 2)

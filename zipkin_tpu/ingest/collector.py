@@ -6,7 +6,16 @@ chain (sampling: keep iff debug or the rate test passes,
 SpanSamplerFilter.scala:40-47) and hand survivors to the WriteSpanStore.
 The adaptive controller reads the flow from the store counters and
 moves the sampler's rate (AdaptiveSampler wiring, SURVEY.md §3.5).
-"""
+
+Stats live in the telemetry registry (zipkin_tpu.obs): the old
+``_stats_lock`` dict is gone — every counter bump is an obs.Counter
+increment (one lock per bump, none lost under concurrent queue
+workers, including the failure paths), and each processed batch feeds
+the batch-size and write-latency sketches. With ``self_trace=True``
+the collector also records one genuine Zipkin span per ingest step
+under the ``zipkin-tpu`` service name, written STRAIGHT to the store
+(bypassing queue + sampler, so the tracer can never feed back into the
+stream it measures)."""
 
 from __future__ import annotations
 
@@ -14,6 +23,7 @@ import threading
 import time
 from typing import Optional, Sequence
 
+from zipkin_tpu import obs
 from zipkin_tpu.ingest.queue import ItemQueue
 from zipkin_tpu.models.span import Span
 from zipkin_tpu.sampler.adaptive import (
@@ -46,26 +56,83 @@ class Collector:
         adaptive: Optional[AdaptiveConfig] = None,
         max_queue: int = 500,
         concurrency: int = 10,
+        registry: Optional[obs.Registry] = None,
+        self_trace: bool = False,
+        self_service_name: str = "zipkin-tpu",
     ):
         self.store = store
         self.sampler = sampler or Sampler(1.0)
+        reg = registry or obs.default_registry()
         self.queue: ItemQueue = ItemQueue(
-            self._write, max_size=max_queue, concurrency=concurrency
+            self._write, max_size=max_queue, concurrency=concurrency,
+            registry=reg,
         )
         self.controller = (
             AdaptiveSampleRateController(adaptive) if adaptive else None
         )
         self._flow = FlowEstimator()
         self._last_tick_s: Optional[float] = None
-        self.spans_dropped = 0
-        self.spans_stored = 0
-        self.bad_payloads = 0
-        # Counters are read-modify-written from every queue worker; the
-        # adaptive controller reads them, so lost increments skew rates.
-        self._stats_lock = threading.Lock()
+        self._c_stored = reg.register(obs.Counter(
+            "zipkin_collector_spans_stored_total",
+            "Spans written to the store after the sampler filter"))
+        self._c_dropped = reg.register(obs.Counter(
+            "zipkin_collector_spans_dropped_total",
+            "Spans dropped by the sampler"))
+        self._c_bad = reg.register(obs.Counter(
+            "zipkin_collector_bad_payloads_total",
+            "Transport segments that failed thrift decode"))
+        self._h_batch = reg.register(obs.LatencySketch(
+            "zipkin_collector_batch_spans",
+            "Spans per processed collector batch (size distribution)",
+            min_value=1.0))
+        self._h_write = reg.register(obs.LatencySketch(
+            "zipkin_collector_write_seconds",
+            "Collector batch processing latency: decode + sample + "
+            "store write, per queue item"))
+        # Sampler-stage metrics ride the collector's registration (the
+        # sampler already locks its own counts; these adapt them).
+        reg.register(obs.Gauge(
+            "zipkin_sampler_rate", "Current sample rate [0, 1]",
+            fn=lambda: self.sampler.rate))
+        reg.register(obs.Counter(
+            "zipkin_sampler_allowed_total",
+            "Trace-id sampler decisions that kept the span",
+            fn=lambda: self.sampler.allowed))
+        reg.register(obs.Counter(
+            "zipkin_sampler_denied_total",
+            "Trace-id sampler decisions that dropped the span",
+            fn=lambda: self.sampler.denied))
+        # Ingest-step self-tracing (SURVEY §5): transport writes DIRECT
+        # to the store — never through accept()/the queue — so a
+        # self-trace span can't generate another self-trace span.
+        # Spans buffer and flush in batches: a device store pays a full
+        # padded ingest launch per apply(), so one launch PER PROCESSED
+        # ITEM would double ingest dispatches and pollute the store's
+        # own launch metrics with 1-span steps.
+        self.tracer = None
+        self._self_buf = []
+        self._self_lock = threading.Lock()
+        if self_trace:
+            from zipkin_tpu.client import Tracer
+
+            self.tracer = Tracer(self_service_name, self._self_transport)
         # The fast path needs both the native parser and a store that
         # accepts raw thrift (TpuSpanStore.write_thrift); probed once.
         self._fast_ok: Optional[bool] = None
+
+    # -- registry-backed stats (read by /metrics json + the controller) -
+
+    @property
+    def spans_stored(self) -> int:
+        return int(self._c_stored.value)
+
+    @property
+    def spans_dropped(self) -> int:
+        return int(self._c_dropped.value)
+
+    @property
+    def bad_payloads(self) -> int:
+        return int(self._c_bad.value)
 
     # -- pipeline -------------------------------------------------------
 
@@ -94,27 +161,75 @@ class Collector:
                 self._fast_ok = native.available()
         return self._fast_ok
 
+    # Self spans per store write: amortizes the device store's
+    # per-launch dispatch floor over many ingest-step spans.
+    SELF_TRACE_FLUSH = 64
+
+    def _self_transport(self, spans) -> None:
+        with self._self_lock:
+            self._self_buf.extend(spans)
+            if len(self._self_buf) < self.SELF_TRACE_FLUSH:
+                return
+            batch, self._self_buf = self._self_buf, []
+        try:
+            self.store.apply(batch)
+        except Exception:
+            pass  # self-tracing must never fail an ingest step
+
+    def _flush_self_spans(self) -> None:
+        with self._self_lock:
+            batch, self._self_buf = self._self_buf, []
+        if batch:
+            try:
+                self.store.apply(batch)
+            except Exception:
+                pass
+
     def _write(self, item) -> None:
-        if isinstance(item, _ThriftPayload):
-            self._write_thrift(item.segments)
-            return
-        spans = item
+        """Queue worker entry: time the step, process, self-trace."""
+        t0 = time.perf_counter()
+        stored = 0
+        try:
+            if isinstance(item, _ThriftPayload):
+                stored = self._write_thrift(item.segments)
+            else:
+                stored = self._write_spans(item)
+        finally:
+            dt = time.perf_counter() - t0
+            self._h_write.observe(dt)
+            if self.tracer is not None:
+                self._emit_self_span(dt, stored)
+
+    def _emit_self_span(self, dt_s: float, stored: int) -> None:
+        from zipkin_tpu.client import B3Headers
+
+        end_us = int(time.time() * 1e6)
+        resolved = self.tracer.resolve(B3Headers())
+        self.tracer.server_span(
+            "collector ingest", resolved,
+            start_us=end_us - max(int(dt_s * 1e6), 1), end_us=end_us,
+            tags={"ingest.stored": str(stored)},
+        )
+
+    def _write_spans(self, spans) -> int:
+        """Sample + store one span batch; returns the stored count."""
         kept = [s for s in spans if s.debug or self.sampler.decide(s.trace_id)]
         # One locked counter update per batch (debug spans bypass the
         # sampler and are not counted, matching the fast path).
         n_debug = sum(1 for s in kept if s.debug)
         self.sampler.count(len(kept) - n_debug, len(spans) - len(kept))
-        with self._stats_lock:
-            self.spans_dropped += len(spans) - len(kept)
+        self._h_batch.observe(len(spans))
+        self._c_dropped.inc(len(spans) - len(kept))
         if kept:
             self.store.apply(kept)
-            with self._stats_lock:
-                self.spans_stored += len(kept)
+            self._c_stored.inc(len(kept))
+        return len(kept)
 
-    def _write_thrift(self, segments) -> None:
+    def _write_thrift(self, segments) -> int:
+        """Fast-path write; returns the stored count (summed across
+        split-and-retry recursion)."""
         if not self._fast_path_available():
-            self._decode_segments_slow(segments)
-            return
+            return self._decode_segments_slow(segments)
         from zipkin_tpu.native import ParseCapacityError
 
         try:
@@ -126,24 +241,22 @@ class Collector:
             # still don't fit go through the chunking python path).
             if len(segments) > 1:
                 mid = len(segments) // 2
-                self._write_thrift(segments[:mid])
-                self._write_thrift(segments[mid:])
-            else:
-                self._decode_segments_slow(segments)
-            return
+                return (self._write_thrift(segments[:mid])
+                        + self._write_thrift(segments[mid:]))
+            return self._decode_segments_slow(segments)
         except ValueError:
             # A corrupt segment poisons the concatenated parse; isolate
             # it by decoding per segment (slow-path semantics: skip bad,
             # keep good — ScribeReceiver's per-entry 'bad' accounting).
-            self._decode_segments_slow(segments)
-            return
+            return self._decode_segments_slow(segments)
         # Slow-path counter parity: debug spans never hit the sampler.
         self.sampler.count(written - written_debug, dropped)
-        with self._stats_lock:
-            self.spans_stored += written
-            self.spans_dropped += dropped
+        self._h_batch.observe(max(written + dropped, 1))
+        self._c_stored.inc(written)
+        self._c_dropped.inc(dropped)
+        return written
 
-    def _decode_segments_slow(self, segments) -> None:
+    def _decode_segments_slow(self, segments) -> int:
         from zipkin_tpu.wire.thrift import ThriftError, spans_from_bytes
 
         spans = []
@@ -151,10 +264,10 @@ class Collector:
             try:
                 spans.extend(spans_from_bytes(seg))
             except ThriftError:
-                with self._stats_lock:
-                    self.bad_payloads += 1
+                self._c_bad.inc()
         if spans:
-            self._write(spans)
+            return self._write_spans(spans)
+        return 0
 
     # -- control loop (call periodically, e.g. every 30s) ---------------
 
@@ -192,7 +305,9 @@ class Collector:
 
     def flush(self) -> None:
         self.queue.join()
+        self._flush_self_spans()
 
     def close(self) -> None:
         self.queue.close()
+        self._flush_self_spans()
         self.store.close()

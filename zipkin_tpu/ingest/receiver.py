@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import enum
 import json
+import threading
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 from zipkin_tpu.ingest.queue import QueueFullException
@@ -52,9 +53,16 @@ class ScribeReceiver:
         self.process = process
         self.process_thrift = process_thrift
         self.categories = {c.lower() for c in categories}
+        # Bumped from every API handler thread; unlocked += would lose
+        # increments under concurrent Log() calls.
+        self._stats_lock = threading.Lock()
         self.stats: Dict[str, int] = {
             "received": 0, "ignored": 0, "bad": 0, "pushed_back": 0,
         }
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] += n
 
     def log(self, entries: Sequence[tuple]) -> ResultCode:
         """entries: (category, message) pairs — the Scribe.Log call.
@@ -69,20 +77,20 @@ class ScribeReceiver:
             return self._log_fast(entries)
         spans: List[Span] = []
         for category, message in entries:
-            self.stats["received"] += 1
+            self._bump("received")
             if category.lower() not in self.categories:
-                self.stats["ignored"] += 1
+                self._bump("ignored")
                 continue
             try:
                 spans.append(scribe_message_to_span(message))
             except ThriftError:
-                self.stats["bad"] += 1
+                self._bump("bad")
         if not spans:
             return ResultCode.OK
         try:
             self.process(spans)
         except QueueFullException:
-            self.stats["pushed_back"] += 1
+            self._bump("pushed_back")
             return ResultCode.TRY_LATER
         return ResultCode.OK
 
@@ -92,16 +100,16 @@ class ScribeReceiver:
 
         raws: List[bytes] = []
         for category, message in entries:
-            self.stats["received"] += 1
+            self._bump("received")
             if category.lower() not in self.categories:
-                self.stats["ignored"] += 1
+                self._bump("ignored")
                 continue
             try:
                 if isinstance(message, str):
                     message = message.encode("ascii")
                 raws.append(base64.b64decode(message, validate=False))
             except (binascii.Error, ValueError):
-                self.stats["bad"] += 1
+                self._bump("bad")
         if not raws:
             return ResultCode.OK
         try:
@@ -110,7 +118,7 @@ class ScribeReceiver:
             # whole batch.
             self.process_thrift(raws)
         except QueueFullException:
-            self.stats["pushed_back"] += 1
+            self._bump("pushed_back")
             return ResultCode.TRY_LATER
         return ResultCode.OK
 

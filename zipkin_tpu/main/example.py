@@ -38,6 +38,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="target stored spans/minute; 0 disables adaptive")
     p.add_argument("--queue-max", type=int, default=500)
     p.add_argument("--queue-workers", type=int, default=10)
+    p.add_argument("--no-self-trace-ingest", action="store_true",
+                   help="disable the per-ingest-step zipkin-tpu self "
+                        "spans (API-request self-tracing stays on; "
+                        "see docs/OBSERVABILITY.md)")
     p.add_argument("--seed-traces", type=int, default=0,
                    help="generate N synthetic traces at startup")
     p.add_argument("--checkpoint", default=None,
@@ -115,6 +119,7 @@ def build_app(args):
     collector = Collector(
         store, sampler=Sampler(args.sample_rate), adaptive=adaptive,
         max_queue=args.queue_max, concurrency=args.queue_workers,
+        self_trace=not args.no_self_trace_ingest,
     )
     api = ApiServer(QueryService(store), collector)
     return store, collector, api

@@ -1,0 +1,43 @@
+"""Process-wide telemetry: counters, gauges, latency sketches.
+
+The reference wires Finagle/Ostrich stats receivers through every
+pipeline stage (ZipkinCollectorFactory's statsReceiver plumbing); this
+package is that layer for the reproduction, built on the repo's own
+sketch primitives: latency distributions are a host-side twin of
+``ops.quantile``'s mergeable log-histogram plus ``models.dependencies``'
+streaming Moments (the algebird monoid) — so per-stage sketches stay
+mergeable across processes and (later) shards, exactly the
+"disaggregation across time and space" property PAPERS.md motivates.
+
+Three consumers:
+
+- ``Registry.render_text()`` — Prometheus text exposition (the API's
+  ``GET /metrics``; the JSON form stays at ``/metrics?format=json``);
+- ``Registry.as_dict()`` — flat snapshot for BENCH json / debugging;
+- self-tracing (api.server + ingest.collector) — the pipeline records
+  genuine Zipkin spans about itself into its own store under the
+  ``zipkin-tpu`` service name.
+
+Components take a ``registry`` argument defaulting to the process-wide
+instance (``default_registry()``); registering a name twice replaces
+the earlier metric (newest pipeline object wins — the earlier one keeps
+counting into its own, now-unscraped, object).
+"""
+
+from zipkin_tpu.obs.registry import (
+    CallbackFamily,
+    Counter,
+    Gauge,
+    LatencySketch,
+    Registry,
+    default_registry,
+)
+
+__all__ = [
+    "CallbackFamily",
+    "Counter",
+    "Gauge",
+    "LatencySketch",
+    "Registry",
+    "default_registry",
+]

@@ -232,7 +232,10 @@ def stack_batches(batches) -> Tuple:
 # ---------------------------------------------------------------------------
 
 
-class ShardedSpanStore:
+from zipkin_tpu.store.base import SuspectGuard
+
+
+class ShardedSpanStore(SuspectGuard):
     """SpanStore SPI over an n-shard device mesh.
 
     Writes route whole traces to shards by trace-id hash (the role of
@@ -303,6 +306,9 @@ class ShardedSpanStore:
         if not spans:
             return
         with self._lock:
+            # Donating sharded ingest must not race an orphaned
+            # checkpoint reader (see store.base.SuspectGuard).
+            self.ensure_writable()
             for s in spans:
                 self.ttls.setdefault(to_signed64(s.trace_id), 1.0)
             prune_ttls(self.ttls, TpuSpanStore.MAX_TTL_ENTRIES)
@@ -1122,6 +1128,9 @@ class ShardedSpanStore:
         if self.inner._batches_since_sweep:
             with self._lock:
                 if self.inner._batches_since_sweep:
+                    # The sweep step donates state buffers — same
+                    # suspect gate as every other donating path.
+                    self.ensure_writable()
                     with self._rw.write():
                         self.inner.sweep()
         with self._rw.read():
@@ -1199,3 +1208,37 @@ class ShardedSpanStore:
         source for the adaptive controller (the ZK group-sum role,
         AdaptiveSampler.scala:204-237)."""
         return float(self._cat("spans_seen"))
+
+    def counters(self) -> Dict[str, float]:
+        """Store-stage counters for /metrics: per-shard device counter
+        blocks summed across the mesh (occupancy/laps are per-shard
+        quantities, so sums read as mesh totals; ts_min/ts_max reduce
+        by min/max). Memoized on the host-side write clocks — same
+        fetched-once-per-ingest-step contract as
+        TpuSpanStore.counter_block, so scrapes between writes cost no
+        device traffic."""
+        import jax
+
+        from zipkin_tpu.store import device as dev
+
+        key = (self.inner._wp_upper, self.inner._batches_since_sweep,
+               self.inner._archived_lower)
+        memo = getattr(self, "_cblock_memo", None)
+        if memo is not None and memo[0] == key:
+            return dict(memo[1])
+        with self._rw.read():
+            blocks = np.asarray(jax.device_get(jax.vmap(
+                dev.counter_block.__wrapped__
+            )(self.inner.states)))
+        out: Dict[str, float] = {}
+        for i, name in enumerate(dev.COUNTER_BLOCK_FIELDS):
+            col = blocks[:, i]
+            if name == "ts_min":
+                out[name] = float(col.min())
+            elif name == "ts_max":
+                out[name] = float(col.max())
+            else:
+                out[name] = float(col.sum())
+        out["shards"] = float(self.n)
+        self._cblock_memo = (key, dict(out))
+        return out

@@ -55,7 +55,7 @@ class QueryCoalescer:
     window just widens them.
     """
 
-    def __init__(self, store, window_s: float = 0.002):
+    def __init__(self, store, window_s: float = 0.002, registry=None):
         self.store = store
         self.window_s = window_s
         self._cv = threading.Condition()
@@ -63,11 +63,19 @@ class QueryCoalescer:
         self._leader_active = False
         # Observability (surfaced via /metrics): launches_saved is the
         # number of device dispatches coalescing removed vs one-call-
-        # per-request.
+        # per-request; the sketch is the full batch-size distribution
+        # (queries per coalesced launch).
         self.batches = 0
         self.queries = 0
         self.launches_saved = 0
         self.max_batch = 0
+        from zipkin_tpu import obs
+
+        reg = registry or obs.default_registry()
+        self._h_batch = reg.register(obs.LatencySketch(
+            "zipkin_query_coalesce_batch_queries",
+            "Queries per coalesced device launch (size distribution)",
+            min_value=1.0))
 
     def run(self, queries: Sequence[tuple]) -> List[list]:
         """Resolve ``queries`` (SpanStore.get_trace_ids_multi tuples),
@@ -135,6 +143,7 @@ class QueryCoalescer:
                 self.launches_saved += len(batch) - 1
                 self.max_batch = max(self.max_batch, len(batch))
                 self._cv.notify_all()
+            self._h_batch.observe(max(n_q, 1))
         if slot.error is not None:
             raise slot.error
         return slot.results

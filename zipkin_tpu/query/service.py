@@ -41,6 +41,7 @@ class QueryService:
         adjust_clock_skew: bool = True,
         duration_batch: int = DURATION_FETCH_BATCH,
         coalesce_window_s: Optional[float] = None,
+        registry=None,
     ):
         self.store = store
         self.adjust_clock_skew = adjust_clock_skew
@@ -66,7 +67,8 @@ class QueryService:
         # singular dispatches; results are exactly serial execution's
         # (see QueryCoalescer).
         self.coalescer = QueryCoalescer(store,
-                                        window_s=coalesce_window_s)
+                                        window_s=coalesce_window_s,
+                                        registry=registry)
 
     def _multi(self, queries) -> List[List[IndexedTraceId]]:
         return self.coalescer.run(queries)

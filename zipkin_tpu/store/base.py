@@ -16,6 +16,7 @@ async boundary in this framework lives in the ingest queue
 from __future__ import annotations
 
 import abc
+import threading
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Set
 
@@ -441,7 +442,81 @@ def durations_from_mat(trace_ids, canon, qids, mat, pins: PinBank, lock):
     return [by_tid[t] for t in trace_ids if t in by_tid]
 
 
-class WriteSpanStore(abc.ABC):
+class StoreSuspectError(RuntimeError):
+    """The store's device state may still be read by an orphaned
+    transfer thread (a slab-save timeout abandoned a wedged
+    ``device_get``); donating writes must not run until the orphan is
+    joined (ADVICE r5 checkpoint hazard)."""
+
+
+_SUSPECT_LOCK = threading.Lock()
+
+
+class SuspectGuard:
+    # -- suspect protocol (checkpoint slab-timeout hazard) --------------
+    # A deadline-bounded checkpoint save that times out leaves its
+    # device_get running on an abandoned daemon thread, which may still
+    # be READING the state buffers after the save's read lock releases.
+    # A donating ingest step (or a fresh save's consistent cut) racing
+    # that orphan reads/writes freed-or-reused buffers. checkpoint.save
+    # stamps the store via mark_suspect(); every donating write path
+    # calls ensure_writable() first, which joins the orphans (bounded)
+    # and either clears the flag or raises StoreSuspectError.
+    _suspect = False
+
+    def mark_suspect(self, orphan=None) -> None:
+        """Flag the device state as possibly-shared with an orphaned
+        reader thread; ``orphan`` is the abandoned Thread when known."""
+        with _SUSPECT_LOCK:
+            self._suspect = True
+            if orphan is not None:
+                if not hasattr(self, "_suspect_orphans"):
+                    self._suspect_orphans = []
+                self._suspect_orphans.append(orphan)
+
+    @property
+    def suspect(self) -> bool:
+        return self._suspect
+
+    def ensure_writable(self, wait_s: float = 0.0) -> None:
+        """No-op unless suspect. Joins each known orphan for up to
+        ``wait_s``; the flag clears only if EVERY currently-recorded
+        orphan is finished at re-check time (a concurrent save timeout
+        may have appended a new orphan while we joined the snapshot),
+        else StoreSuspectError. A suspect store with no recorded
+        orphans can only be cleared explicitly (clear_suspect) or by a
+        process restart."""
+        if not self._suspect:
+            return
+        with _SUSPECT_LOCK:
+            orphans = list(getattr(self, "_suspect_orphans", ()))
+        for t in orphans:
+            t.join(wait_s)
+        with _SUSPECT_LOCK:
+            if not self._suspect:
+                return
+            current = getattr(self, "_suspect_orphans", [])
+            alive = [t for t in current if t.is_alive()]
+            if alive or not current:
+                if hasattr(self, "_suspect_orphans"):
+                    self._suspect_orphans[:] = alive
+                raise StoreSuspectError(
+                    "store state may be shared with an orphaned "
+                    "device_get reader (slab-save timeout); retry after "
+                    "the transfer un-wedges or restart the process"
+                )
+            self._suspect_orphans[:] = []
+            self._suspect = False
+
+    def clear_suspect(self) -> None:
+        """Operator override: declare the orphan dealt with."""
+        with _SUSPECT_LOCK:
+            self._suspect = False
+            if hasattr(self, "_suspect_orphans"):
+                self._suspect_orphans[:] = []
+
+
+class WriteSpanStore(SuspectGuard, abc.ABC):
     @abc.abstractmethod
     def apply(self, spans: Sequence[Span]) -> None:
         """Store a batch of spans."""

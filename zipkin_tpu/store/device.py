@@ -1746,6 +1746,74 @@ def dep_archive_auto(state: "StoreState", incoming=None) -> "StoreState":
     return dep_close_bucket(state)
 
 
+def stablehlo_op_census(stablehlo_text: str,
+                        ops=("scatter", "gather", "sort")) -> dict:
+    """Scatter/gather/sort census of a StableHLO lowering — the ONE
+    counter behind the tier-1 95/5 ceiling (scripts/bench_smoke.py),
+    TpuSpanStore.step_census, and the counter-block purity gate; keep a
+    single definition so the gate and the runtime observable can never
+    drift. Backend-independent: counts ops the program ISSUES, not what
+    a backend fuses away."""
+    import re
+
+    return {
+        op: len(re.findall(rf'"stablehlo\.{op}"', stablehlo_text))
+        for op in ops
+    }
+
+
+# Telemetry counter block: every scalar the obs layer wants, packed
+# into ONE [N] i64 vector so a metrics scrape costs one fused read-only
+# launch + one D2H instead of a dict of tiny transfers. Derived values
+# (occupancy, laps, poison census) are computed HERE at fetch time from
+# cursors the ingest step already maintains — the block adds ZERO ops
+# to the ingest step itself (scripts/bench_smoke.py asserts the step's
+# scatter/sort census is unchanged and that this fetch lowers with no
+# scatter/sort at all).
+COUNTER_BLOCK_FIELDS = (
+    "write_pos", "ann_write_pos", "bann_write_pos", "pend_pos",
+    "dep_bank_seq", "ring_occupancy", "ring_laps", "ann_ring_occupancy",
+    "bann_ring_occupancy", "pend_depth", "poisoned_services",
+    "spans_seen", "anns_seen", "banns_seen", "batches",
+    "key_claim_drops", "sweeps", "ts_min", "ts_max",
+)
+
+
+@jax.jit
+def counter_block(state: StoreState) -> jnp.ndarray:
+    """[len(COUNTER_BLOCK_FIELDS)] i64 — see COUNTER_BLOCK_FIELDS."""
+    c = state.config
+    wp = state.write_pos
+    poisoned = jnp.sum(
+        (state.ann_poison >= wp - c.capacity)
+        & (state.ann_poison > I64_MIN)
+    ).astype(jnp.int64)
+    vals = {
+        "write_pos": wp,
+        "ann_write_pos": state.ann_write_pos,
+        "bann_write_pos": state.bann_write_pos,
+        "pend_pos": state.pend_pos,
+        "dep_bank_seq": state.dep_bank_seq,
+        "ring_occupancy": jnp.minimum(wp, c.capacity),
+        "ring_laps": wp // c.capacity,
+        "ann_ring_occupancy": jnp.minimum(state.ann_write_pos,
+                                          c.ann_capacity),
+        "bann_ring_occupancy": jnp.minimum(state.bann_write_pos,
+                                           c.bann_capacity),
+        "pend_depth": jnp.minimum(state.pend_pos, c.pending_slots),
+        "poisoned_services": poisoned,
+        "ts_min": state.ts_min,
+        "ts_max": state.ts_max,
+        **{k: state.counters[k] for k in (
+            "spans_seen", "anns_seen", "banns_seen", "batches",
+            "key_claim_drops", "sweeps",
+        )},
+    }
+    return jnp.stack([
+        jnp.asarray(vals[f], jnp.int64) for f in COUNTER_BLOCK_FIELDS
+    ])
+
+
 @jax.jit
 def _total_dep_impl(dep_moments, dep_banks, dep_window):
     banks = M.reduce_moments(dep_banks, axis=0)

@@ -149,6 +149,18 @@ class InMemorySpanStore(SpanStore):
         with self._lock:
             return float(len(self.spans))
 
+    def counters(self) -> Dict[str, float]:
+        """Minimal store-stage counters (the /metrics hook every
+        backend answers; the TPU store serves its device counter block
+        through the same shape)."""
+        with self._lock:
+            return {
+                "spans_stored": float(len(self.spans)),
+                "traces_stored": float(
+                    len({s.trace_id for s in self.spans})
+                ),
+            }
+
     def get_all_service_names(self) -> Set[str]:
         with self._lock:
             snapshot = list(self.spans)

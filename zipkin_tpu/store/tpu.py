@@ -282,7 +282,8 @@ _BANN_COLS = ("bann_key_id", "bann_value_id", "bann_type",
 
 class TpuSpanStore(SpanStore):
     def __init__(self, config: Optional[dev.StoreConfig] = None,
-                 codec: Optional[SpanCodec] = None):
+                 codec: Optional[SpanCodec] = None,
+                 registry=None):
         self.config = config or dev.StoreConfig()
         self.codec = codec or SpanCodec()
         self.state = dev.init_state(self.config)
@@ -320,6 +321,26 @@ class TpuSpanStore(SpanStore):
         self.index_fallbacks = 0
         # name_id -> lowercased-name id, maintained incrementally.
         self._name_lc: Dict[int, int] = {}
+        # Telemetry (zipkin_tpu.obs): the device counter block is
+        # fetched at most ONCE per ingest progress — _step_seq bumps on
+        # every state mutation and keys the memo, so metric scrapes
+        # between ingest steps reuse the cached block instead of
+        # launching a D2H each.
+        self._step_seq = 0
+        self._cblock_memo: Optional[tuple] = None
+        from zipkin_tpu import obs
+
+        reg = registry or obs.default_registry()
+        self._h_ingest = reg.register(obs.LatencySketch(
+            "zipkin_store_ingest_step_seconds",
+            "Device ingest launch latency (per fused step/chain, "
+            "dispatch + compute + host bookkeeping)"))
+        self._c_launches = reg.register(obs.Counter(
+            "zipkin_store_ingest_launches_total",
+            "Device ingest launches (chained chunks count as one)"))
+        # The zipkin_store_counter family is registered by ApiServer
+        # from the generic counters() hook (one registration site for
+        # every backend), not here.
 
     @property
     def dicts(self) -> DictionarySet:
@@ -603,6 +624,10 @@ class TpuSpanStore(SpanStore):
         pad_s = _next_pow2(max(b.n_spans for b, _, _ in group))
         pad_a = _next_pow2(max(b.n_annotations for b, _, _ in group))
         pad_b = _next_pow2(max(b.n_binary for b, _, _ in group))
+        self.ensure_writable()
+        import time as _time
+
+        t0 = _time.perf_counter()
         dbs = [
             dev.make_device_batch(
                 b, name_lc_id=lc, indexable=ix,
@@ -616,6 +641,8 @@ class TpuSpanStore(SpanStore):
         with self._rw.write():
             self.state = dev.ingest_steps(self.state, stacked)
         self._wp += total
+        self._step_seq += 1
+        self._observe_ingest(_time.perf_counter() - t0)
         self._batches_since_sweep += len(group)
         if self._batches_since_sweep >= self.SWEEP_EVERY:
             self._sweep_pending()
@@ -624,6 +651,10 @@ class TpuSpanStore(SpanStore):
                       indexable: np.ndarray) -> None:
         """Pad, upload, and run the fused ingest step for one chunk that
         already fits the ring capacities."""
+        self.ensure_writable()
+        import time as _time
+
+        t0 = _time.perf_counter()
         db = dev.make_device_batch(
             batch,
             name_lc_id=name_lc,
@@ -636,9 +667,15 @@ class TpuSpanStore(SpanStore):
         with self._rw.write():
             self.state = dev.ingest_step(self.state, db)
         self._wp += batch.n_spans
+        self._step_seq += 1
+        self._observe_ingest(_time.perf_counter() - t0)
         self._batches_since_sweep += 1
         if self._batches_since_sweep >= self.SWEEP_EVERY:
             self._sweep_pending()
+
+    def _observe_ingest(self, dt_s: float) -> None:
+        self._h_ingest.observe(dt_s)
+        self._c_launches.inc()
 
     # Write-path sweep cadence (batches). Each sweep is one small launch
     # over the pending ring; 64 bounds a cross-batch child's link
@@ -647,8 +684,10 @@ class TpuSpanStore(SpanStore):
 
     def _sweep_pending(self) -> None:
         """Resolve pending (late-parent) children now; see dev.dep_sweep."""
+        self.ensure_writable()
         with self._rw.write():
             self.state = dev.dep_sweep(self.state)
+        self._step_seq += 1
         self._batches_since_sweep = 0
 
     def _maybe_archive(self, incoming: int) -> None:
@@ -660,8 +699,10 @@ class TpuSpanStore(SpanStore):
         cap = self.config.capacity
         if self._wp + incoming - self._archived <= cap:
             return
+        self.ensure_writable()
         with self._rw.write():
             self.state = dev.dep_close_bucket(self.state)
+        self._step_seq += 1
         self._batches_since_sweep = 0
         self._archived = min(
             self._wp, max(self._wp + incoming - cap, self._wp - cap // 2)
@@ -682,8 +723,10 @@ class TpuSpanStore(SpanStore):
         unresolved pending children, so the first dependency read must
         run a pending sweep (the streaming-join contract) even though no
         store-mediated batch was ever written."""
+        self.ensure_writable()
         with self._rw.write():
             self.state = state
+        self._step_seq += 1
         self._wp = int(spans_written)
         self._archived = self._wp if archived is None else int(archived)
         self._batches_since_sweep = 1
@@ -1103,8 +1146,10 @@ class TpuSpanStore(SpanStore):
         hourly-aggregation-timer role of zipkin-deployment-web's
         AnormAggregator schedule)."""
         with self._lock:
+            self.ensure_writable()
             with self._rw.write():
                 self.state = dev.dep_close_bucket(self.state)
+            self._step_seq += 1
             self._archived = self._wp
             self._batches_since_sweep = 0
 
@@ -1162,10 +1207,55 @@ class TpuSpanStore(SpanStore):
             regs = jax.device_get(self.state.hll_traces)
         return float(hll.estimate(hll.HyperLogLog(regs)))
 
-    def counters(self) -> Dict[str, float]:
+    def counter_block(self) -> Dict[str, int]:
+        """The device counter block (dev.COUNTER_BLOCK_FIELDS): ring
+        occupancy/laps, queue depths, poison census, and the ingest
+        counters — ONE fused read-only launch + ONE scalar-vector D2H,
+        memoized per ingest step (_step_seq), so any number of metric
+        scrapes between steps costs zero device traffic. Maintaining
+        the block adds no ops to the ingest step itself — the derived
+        values are computed at fetch time from cursors the step already
+        keeps (bench_smoke's census gate holds with telemetry on)."""
+        key = self._step_seq
+        memo = self._cblock_memo
+        if memo is not None and memo[0] == key:
+            return memo[1]
         with self._rw.read():
-            vals = jax.device_get(self.state.counters)
-        out = {k: float(v) for k, v in vals.items()}
+            vec = jax.device_get(dev.counter_block(self.state))
+        blk = {
+            name: int(v)
+            for name, v in zip(dev.COUNTER_BLOCK_FIELDS, vec)
+        }
+        self._cblock_memo = (key, blk)
+        return blk
+
+    def step_census(self, n_spans: int = 256, n_anns: int = 512,
+                    n_banns: int = 256) -> Dict[str, int]:
+        """Scatter/gather/sort census of the fused ingest step's
+        StableHLO lowering at the given pad shapes — the portable proxy
+        for per-batch launch cost (NOTES_r03 §3; gated in tier-1 at
+        95 scatters / 5 sorts). Memoized per shape; computed only when
+        asked (a trace, not a compile) — metric scrapes never pay it."""
+        key = (n_spans, n_anns, n_banns)
+        memo = getattr(self, "_census_memo", None)
+        if memo is not None and memo[0] == key:
+            return memo[1]
+        from zipkin_tpu.columnar.schema import SpanBatch
+
+        batch = SpanBatch.empty(0, 0, 0)
+        db = dev.make_device_batch(
+            batch, name_lc_id=np.zeros(0, np.int32),
+            indexable=np.zeros(0, bool),
+            pad_spans=n_spans, pad_anns=n_anns, pad_banns=n_banns,
+        )
+        with self._rw.read():
+            text = dev.ingest_step.lower(self.state, db).as_text()
+        census = dev.stablehlo_op_census(text)
+        self._census_memo = (key, census)
+        return census
+
+    def counters(self) -> Dict[str, float]:
+        out = {k: float(v) for k, v in self.counter_block().items()}
         # Host-side guards surface through the same hook (the API's
         # /metrics reads counters() generically).
         out["anns_truncated"] = float(self.anns_truncated)
@@ -1175,8 +1265,8 @@ class TpuSpanStore(SpanStore):
         return out
 
     def stored_span_count(self) -> float:
-        """The DEVICE spans_seen counter (one scalar D2H per control
-        tick) — the adaptive controller's flow source reads the sketch
-        state itself, not a host mirror."""
-        with self._rw.read():
-            return float(self.state.counters["spans_seen"])
+        """The DEVICE spans_seen counter — the adaptive controller's
+        flow source reads the sketch state itself, not a host mirror.
+        Served from the per-step counter block (at most one D2H per
+        ingest step, shared with every other telemetry read)."""
+        return float(self.counter_block()["spans_seen"])
