@@ -683,6 +683,89 @@ def _bounded(fn, timeout_s: float, label: str):
     return result.get("value")
 
 
+def bench_archive(total_spans: int = 100_000):
+    """Cold-tier phase: stream ~4 ring turns through a TieredSpanStore
+    (store/archive) and measure what the paging layer costs and buys —
+    capture overhead vs an identical sink-less store (same spans, warm
+    jit cache), cold trace-fetch latency over EVICTED traces, segment
+    compression ratio, and identity vs the memory oracle on a sample.
+    The ring is sized to total_spans/4 so the stream laps it ~4x."""
+    import numpy as np  # noqa: F401
+
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.archive import ArchiveParams, TieredSpanStore
+    from zipkin_tpu.store.memory import InMemorySpanStore
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    cap = 1 << max(9, (total_spans // 4).bit_length() - 1)
+    config = dev.StoreConfig(
+        capacity=cap, ann_capacity=4 * cap, bann_capacity=2 * cap,
+        max_services=64, max_span_names=256,
+        max_annotation_values=512, max_binary_keys=64,
+        cms_width=1 << 12, hll_p=10, quantile_buckets=512,
+    )
+    _log(f"archive phase: ring 2^{cap.bit_length() - 1}, "
+         f"{total_spans} spans (~4 laps)")
+    spans = []
+    while len(spans) < total_spans:
+        spans.extend(
+            s for t in generate_traces(
+                n_traces=max(total_spans // 5, 64), max_depth=3,
+                n_services=32,
+            ) for s in t
+        )
+    spans = spans[:total_spans]
+    chunk = 1024
+
+    def stream(store):
+        t0 = time.perf_counter()
+        for i in range(0, len(spans), chunk):
+            store.apply(spans[i:i + chunk])
+        return time.perf_counter() - t0
+
+    stream(TpuSpanStore(config))  # jit warm-up (uncounted)
+    plain_s = stream(TpuSpanStore(config))
+    hot = TpuSpanStore(config)
+    tiered = TieredSpanStore(
+        hot, params=ArchiveParams.for_config(config))
+    tiered_s = stream(tiered)
+
+    oracle = InMemorySpanStore()
+    oracle.apply(spans)
+    tids = sorted({s.trace_id for s in spans})
+    sample = tids[:3] + tids[len(tids) // 2:len(tids) // 2 + 3] \
+        + tids[-3:]
+    t0 = time.perf_counter()
+    identical = all(
+        tiered.get_spans_by_trace_ids([t])
+        == oracle.get_spans_by_trace_ids([t]) for t in sample
+    )
+    cold_fetch_s = time.perf_counter() - t0
+    c = tiered.counters()
+    return {
+        "spans": len(spans),
+        "ring_capacity": cap,
+        "ingest_plain_s": round(plain_s, 2),
+        "ingest_tiered_s": round(tiered_s, 2),
+        "capture_overhead_pct": round(
+            100.0 * (tiered_s - plain_s) / plain_s, 1),
+        "cold_fetch_ms_per_trace": round(
+            cold_fetch_s / len(sample) * 1e3, 2),
+        "segments_written": int(c["archive_segments_written"]),
+        "compactions": int(c["archive_compactions"]),
+        "segments_live": int(c["archive_segments_live"]),
+        "cold_spans": int(c["archive_cold_spans"]),
+        "cold_mb": round(c["archive_cold_bytes"] / 1e6, 2),
+        "cold_compression_ratio": round(
+            c["archive_cold_raw_bytes"]
+            / max(c["archive_cold_bytes"], 1.0), 2),
+        "capture_latency": tiered.archive.h_capture.snapshot(),
+        "cold_query_latency": tiered.archive.h_cold_query.snapshot(),
+        "identical_vs_oracle": bool(identical),
+    }
+
+
 def bench_checkpoint(store):
     """Checkpoint at bench scale (VERDICT r3 item 8): snapshot the
     streamed store, restore it, and require bit-identical answers to a
@@ -958,6 +1041,15 @@ def main():
             budget_s=None if args.smoke else args.exactness_budget,
         )
         emit("stream+queries+exactness")
+        # Cold-tier paging layer (store/archive): capture overhead and
+        # cold-query latency at ~4 ring laps. Bounded separately from
+        # the main stream (its own small ring), so a failure here
+        # can't strand the already-emitted core phases.
+        detail["archive_cold_tier"] = _bounded(
+            lambda: bench_archive(
+                int(2e4) if args.smoke else int(4e5)),
+            timeout_s=900, label="archive")
+        emit("stream+queries+exactness+archive")
         # The XLA-vs-pallas kernel decision was measured and recorded in
         # round 4 (xla 158.6k vs pallas 155.0k spans/s, NOTES_r04 §3);
         # re-measuring it on every full run cost two extra compile+

@@ -46,6 +46,121 @@ def _count_ops(stablehlo_text: str) -> dict:
     return stablehlo_op_census(stablehlo_text)
 
 
+def run_archive() -> dict:
+    """Cold-tier phase: capture -> compact -> cold query, with the
+    memory store as the identity oracle. Proves on every CI run that
+    (a) eviction capture adds ZERO ops to the fused ingest step (its
+    lowering census with a sink attached equals the plain store's),
+    (b) a 4x-ring ingest leaves every evicted span answerable, and
+    (c) zone-map pruning actually skips segments. Also times the
+    capture overhead (ingest with sink vs without, same spans) and the
+    cold trace-fetch latency."""
+    import numpy as np
+
+    from zipkin_tpu.columnar.schema import SpanBatch
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.archive import ArchiveParams, TieredSpanStore
+    from zipkin_tpu.store.memory import InMemorySpanStore
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    config = dev.StoreConfig(
+        capacity=1 << 8, ann_capacity=1 << 10, bann_capacity=1 << 9,
+        max_services=32, max_span_names=64, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=6,
+        quantile_buckets=256,
+    )
+    n_spans = 4 * config.capacity
+    traces = generate_traces(n_traces=n_spans // 4, max_depth=3,
+                             n_services=8)
+    spans = [s for t in traces for s in t][:n_spans]
+    chunk = 128
+
+    # Warm the jit cache on a scratch store so neither timed run pays
+    # compilation (the overhead delta is the measurement, not the
+    # compile).
+    warm = TpuSpanStore(config)
+    for i in range(0, len(spans), chunk):
+        warm.apply(spans[i:i + chunk])
+
+    # Baseline: same spans, no sink.
+    plain = TpuSpanStore(config)
+    t0 = time.perf_counter()
+    for i in range(0, len(spans), chunk):
+        plain.apply(spans[i:i + chunk])
+    plain_s = time.perf_counter() - t0
+
+    hot = TpuSpanStore(config)
+    tiered = TieredSpanStore(hot, params=ArchiveParams.for_config(
+        config, compact_fanin=2, small_span_limit=config.capacity,
+        bloom_bits=1 << 12, cms_width=1 << 10, hll_p=6,
+    ))
+    oracle = InMemorySpanStore()
+    t0 = time.perf_counter()
+    for i in range(0, len(spans), chunk):
+        tiered.apply(spans[i:i + chunk])
+    tiered_s = time.perf_counter() - t0
+    oracle.apply(spans)
+
+    # The fused step's lowering with the sink ATTACHED — must census
+    # identically to the plain store's (capture is a separate launch).
+    db = dev.make_device_batch(
+        SpanBatch.empty(0, 0, 0),
+        name_lc_id=np.zeros(0, np.int32),
+        indexable=np.zeros(0, bool),
+        pad_spans=256, pad_anns=512, pad_banns=256,
+    )
+    ops_plain = _count_ops(
+        dev.ingest_step.lower(plain.state, db).as_text())
+    ops_tiered = _count_ops(
+        dev.ingest_step.lower(hot.state, db).as_text())
+
+    # Identity vs oracle across the whole history (incl. evicted).
+    tids = sorted({s.trace_id for s in spans})
+    sample = tids[:4] + tids[len(tids) // 2:len(tids) // 2 + 4] \
+        + tids[-4:]
+    end_ts = 1 << 60
+    t0 = time.perf_counter()
+    fetch_ok = all(
+        tiered.get_spans_by_trace_ids([t])
+        == oracle.get_spans_by_trace_ids([t]) for t in sample
+    )
+    cold_fetch_s = time.perf_counter() - t0
+    svc = sorted(oracle.get_all_service_names())[0]
+    ids_ok = (
+        tiered.get_trace_ids_by_name(svc, None, end_ts, 10 * n_spans)
+        == oracle.get_trace_ids_by_name(svc, None, end_ts,
+                                        10 * n_spans)
+    )
+    dur_ok = (tiered.get_traces_duration(sample)
+              == oracle.get_traces_duration(sample))
+    pruned0 = tiered.archive.c_pruned.value
+    first_ts = min(s.first_timestamp for s in spans
+                   if s.first_timestamp is not None)
+    tiered.get_trace_ids_by_name(svc, None, first_ts + 1, 4)
+    c = tiered.counters()
+    return {
+        "spans": len(spans),
+        "capture_overhead_pct": round(
+            100.0 * (tiered_s - plain_s) / plain_s, 1),
+        "ingest_plain_s": round(plain_s, 3),
+        "ingest_tiered_s": round(tiered_s, 3),
+        "cold_fetch_ms_per_trace": round(
+            cold_fetch_s / len(sample) * 1e3, 2),
+        "segments_written": int(c["archive_segments_written"]),
+        "compactions": int(c["archive_compactions"]),
+        "segments_pruned": int(
+            tiered.archive.c_pruned.value - pruned0),
+        "cold_spans": int(c["archive_cold_spans"]),
+        "cold_compression_ratio": round(
+            c["archive_cold_raw_bytes"]
+            / max(c["archive_cold_bytes"], 1.0), 2),
+        "identical": bool(fetch_ok and ids_ok and dur_ok),
+        "step_census_with_capture": ops_tiered,
+        "step_census_plain": ops_plain,
+    }
+
+
 def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
     import numpy as np  # noqa: F401  (kept: smoke envs import-check it)
 
@@ -148,6 +263,7 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
     }
     return {
         "metric": "bench_smoke",
+        "archive": run_archive(),
         "spans": total,
         "ingest_spans_per_s": round(total / dt, 1),
         "ingest_ms_per_batch": round(dt / len(dbs) * 1e3, 2),

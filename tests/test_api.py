@@ -401,6 +401,101 @@ class TestStrictJsonEveryRoute:
         _strict_json_roundtrip(body)
 
 
+class TestThriftSliceRoutes:
+    """The three remaining ZipkinQuery thrift methods over HTTP —
+    getSpanDurations, getServiceNamesToTraceIds, getDataTimeToLive
+    (zipkinQuery.thrift) — per backend (memory / sql / tpu): the query
+    layer is store-agnostic, so every backend must answer identically
+    for the same data."""
+
+    def _seed(self, store):
+        store.apply([rpc(1, 10, None, 100, 200)])
+        store.apply([rpc(2, 11, None, 1100, 1300)])
+        store.apply([rpc(3, 12, None, 2100, 2500, name="other")])
+        return ApiServer(QueryService(store), self_trace=False)
+
+    def _check(self, api):
+        status, body = api.handle(
+            "GET", "/api/span_durations",
+            {"serviceName": "web", "spanName": "call"})
+        assert status == 200
+        # rpc() spans are owned by the server side ("api"); traces 1
+        # and 2 carry name "call" with durations 100 and 200 µs. The
+        # index ranks traces by timestamp, so compare unordered.
+        assert set(body["durations"]) == {"api"}
+        assert sorted(body["durations"]["api"]) == [100, 200]
+
+        status, body = api.handle(
+            "GET", "/api/service_names_to_trace_ids",
+            {"serviceName": "web", "spanName": "call"})
+        assert status == 200
+        got = {k: sorted(v) for k, v in body["serviceNames"].items()}
+        assert got == {"api": ["1", "2"], "web": ["1", "2"]}
+
+        # timeStamp restricts the slice like any end_ts.
+        status, body = api.handle(
+            "GET", "/api/span_durations",
+            {"serviceName": "web", "spanName": "call",
+             "timeStamp": "500"})
+        assert status == 200 and body == {"durations":
+                                          {"api": [100]}}
+
+        status, body = api.handle("GET", "/api/data_ttl", {})
+        assert status == 200
+        from zipkin_tpu.store.base import DEFAULT_SPAN_TTL_S
+
+        assert body == {"dataTimeToLive": DEFAULT_SPAN_TTL_S}
+
+        # Missing params are 400s, not stack traces.
+        assert api.handle("GET", "/api/span_durations", {})[0] == 400
+        assert api.handle(
+            "GET", "/api/span_durations", {"serviceName": "web"}
+        )[0] == 400
+        assert api.handle(
+            "GET", "/api/service_names_to_trace_ids", {})[0] == 400
+
+    def test_memory_store(self):
+        self._check(self._seed(InMemorySpanStore()))
+
+    def test_sql_store(self):
+        from zipkin_tpu.store.sql import SqliteSpanStore
+
+        store = SqliteSpanStore()
+        self._check(self._seed(store))
+        store.close()
+
+    def test_tpu_store(self):
+        from zipkin_tpu.store.device import StoreConfig
+        from zipkin_tpu.store.tpu import TpuSpanStore
+
+        store = TpuSpanStore(StoreConfig(
+            capacity=256, ann_capacity=1024, bann_capacity=512,
+            max_services=16, max_span_names=32,
+            max_annotation_values=64, max_binary_keys=16,
+            cms_width=256, hll_p=6, quantile_buckets=128,
+        ))
+        self._check(self._seed(store))
+
+    def test_query_client_methods(self, app):
+        """QueryClient wrappers against the real HTTP server."""
+        from zipkin_tpu.client import QueryClient
+
+        server = make_server(app, host="127.0.0.1", port=0)
+        serve_forever_in_thread(server)
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            qc = QueryClient(base)
+            durs = qc.span_durations("web", "call")
+            assert durs == {"api": [100]}
+            names = qc.service_names_to_trace_ids("web", "call")
+            assert names == {"api": ["1"], "web": ["1"]}
+            from zipkin_tpu.store.base import DEFAULT_SPAN_TTL_S
+
+            assert qc.data_ttl() == DEFAULT_SPAN_TTL_S
+        finally:
+            server.shutdown()
+
+
 class TestTracesExistRoute:
     """tracesExist (zipkinQuery.thrift:154) over HTTP, per backend."""
 

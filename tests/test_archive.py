@@ -1,0 +1,490 @@
+"""Cold-tier segment store tests (store/archive).
+
+The acceptance drive: ingest 4x ring capacity on CPU, then prove trace
+fetch, get_trace_ids, dependencies, and duration quantiles over the
+FULL time range match the memory-store oracle exactly — including
+spans long evicted from the device ring — with the obs counters
+showing segments written, compactions, and zone-map pruning actually
+happening.
+"""
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.models.span import (
+    Annotation,
+    BinaryAnnotation,
+    Endpoint,
+    Span,
+)
+from zipkin_tpu.store.archive import (
+    ArchiveParams,
+    Segment,
+    TieredSpanStore,
+    merge_segments,
+)
+from zipkin_tpu.store.archive import sketches as SK
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.memory import InMemorySpanStore
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.testing.conformance import (
+    conformance_test_names,
+    run_conformance_test,
+)
+
+WEB = Endpoint(0x01010101, 80, "web")
+API = Endpoint(0x02020202, 80, "api")
+DB = Endpoint(0x03030303, 80, "db")
+
+# Small rings so 4x capacity is cheap on CPU; the annotation ring is
+# deliberately tight relative to the span ring (each rpc span carries
+# 5 annotation rows) so the ANNOTATION ring is the capture trigger —
+# the subtle case where side rows evict before their span row.
+CFG = StoreConfig(
+    capacity=1 << 8, ann_capacity=1 << 10, bann_capacity=1 << 9,
+    max_services=16, max_span_names=64, max_annotation_values=128,
+    max_binary_keys=32, cms_width=1 << 9, hll_p=6,
+    quantile_buckets=256,
+)
+PARAMS = ArchiveParams.for_config(
+    CFG, compact_fanin=2, small_span_limit=CFG.capacity,
+    bloom_bits=1 << 12, cms_width=1 << 10, hll_p=6,
+)
+
+
+def rpc(tid, sid, parent, client_ep, server_ep, t0, t1, name="call",
+        extra_ann=None, bann=None):
+    anns = [
+        Annotation(t0, "cs", client_ep),
+        Annotation(t0 + 1, "sr", server_ep),
+        Annotation(t1 - 1, "ss", server_ep),
+        Annotation(t1, "cr", client_ep),
+    ]
+    if extra_ann:
+        anns.append(extra_ann)
+    return Span(tid, name, sid, parent, tuple(anns), tuple(bann or ()))
+
+
+def make_trace(tid):
+    """web->api root + api->db child, deterministic timings; every 3rd
+    trace carries a custom annotation and a binary annotation."""
+    base = 1_000 + 100 * tid
+    spans = [
+        rpc(tid, 10 * tid + 1, None, WEB, API, base, base + 50,
+            name=("index" if tid % 2 else "other"),
+            extra_ann=(Annotation(base + 7, "boom", API)
+                       if tid % 3 == 0 else None),
+            bann=([BinaryAnnotation("k", b"v%d" % (tid % 4), host=API)]
+                  if tid % 3 == 0 else None)),
+        rpc(tid, 10 * tid + 2, 10 * tid + 1, API, DB, base + 5,
+            base + 30, name="lookup"),
+    ]
+    return spans
+
+
+def build_tiered(n_traces):
+    hot = TpuSpanStore(CFG)
+    tiered = TieredSpanStore(hot, params=PARAMS)
+    oracle = InMemorySpanStore()
+    batch = []
+    for tid in range(1, n_traces + 1):
+        batch.extend(make_trace(tid))
+        if len(batch) >= 64:
+            tiered.apply(batch)
+            oracle.apply(batch)
+            batch = []
+    if batch:
+        tiered.apply(batch)
+        oracle.apply(batch)
+    return tiered, oracle
+
+
+class TestSketches:
+    def test_bloom_no_false_negatives_and_merge(self):
+        a = SK.bloom_init(1 << 10)
+        b = SK.bloom_init(1 << 10)
+        keys_a = np.arange(1, 200, dtype=np.int64) * 7919
+        keys_b = np.arange(200, 400, dtype=np.int64) * 104729
+        SK.bloom_add(a, keys_a)
+        SK.bloom_add(b, keys_b)
+        m = SK.bloom_merge(a, b)
+        for k in list(keys_a[:20]) + list(keys_b[:20]):
+            assert SK.bloom_contains(m, int(k))
+
+    def test_cms_zero_proves_absence(self):
+        c = SK.cms_init(4, 1 << 8)
+        SK.cms_add(c, np.asarray([5, 5, 9], np.int64))
+        assert SK.cms_query(c, 5) >= 2
+        assert SK.cms_query(c, 9) >= 1
+        # A key never added can only read >0 through collisions in
+        # EVERY row; at this load the min over 4 rows is 0.
+        absent = [k for k in range(1000, 1100)
+                  if SK.cms_query(c, k) == 0]
+        assert absent  # pruning power exists
+
+    def test_hll_estimate_tracks_cardinality(self):
+        h = SK.hll_init(8)
+        SK.hll_add(h, np.arange(1, 1001, dtype=np.int64) * 2654435761)
+        est = SK.hll_estimate(h)
+        assert 800 <= est <= 1200
+
+    def test_hist_matches_quantiles_host(self):
+        from zipkin_tpu.ops.quantile import quantiles_host
+
+        gamma = PARAMS.hist_gamma
+        counts = np.zeros(256, np.int64)
+        vals = np.asarray([10, 100, 1000, 10_000] * 25, np.int64)
+        SK.hist_add(counts, vals, gamma)
+        q = quantiles_host(counts, gamma, 1.0, [0.5])
+        assert 90 <= q[0] <= 1100  # within the sketch's relative bound
+
+
+class TestSegmentFormat:
+    @pytest.fixture(scope="class")
+    def built(self):
+        tiered, _ = build_tiered(4 * CFG.capacity // 2)
+        segs = tiered.archive.snapshot()
+        assert segs
+        return tiered, segs[0]
+
+    def test_bytes_roundtrip_bit_exact(self, built):
+        _, seg = built
+        twin = Segment.from_bytes(seg.to_bytes())
+        b1, g1 = seg.decode()
+        b2, g2 = twin.decode()
+        assert (g1 == g2).all()
+        for col in type(b1).SPAN_COLUMNS:
+            assert (getattr(b1, col) == getattr(b2, col)).all(), col
+        assert twin.zone.service_ids == seg.zone.service_ids
+        assert (twin.zone.key_cms == seg.zone.key_cms).all()
+        assert (twin.zone.trace_bloom == seg.zone.trace_bloom).all()
+        assert twin.dict_sizes == seg.dict_sizes
+
+    def test_compression_actually_compresses(self, built):
+        _, seg = built
+        assert seg.comp_bytes < seg.raw_bytes / 2
+
+    def test_merge_zone_is_monoidal(self, built):
+        tiered, _ = built
+        segs = tiered.archive.snapshot()
+        if len(segs) < 2:
+            pytest.skip("compaction already folded everything")
+        merged = merge_segments(999, segs[:2])
+        assert merged.n_spans == segs[0].n_spans + segs[1].n_spans
+        assert merged.gid_lo == min(s.gid_lo for s in segs[:2])
+        assert merged.gid_hi == max(s.gid_hi for s in segs[:2])
+        # Anything either part may contain, the merge may contain.
+        b0, g0 = segs[0].decode()
+        for tid in np.unique(b0.trace_id)[:10]:
+            assert merged.zone.may_contain_trace(int(tid))
+
+
+class TestCaptureInvariants:
+    def test_contiguous_coverage_no_gaps(self):
+        """Segments tile [0, captured_upto) without gaps or overlap —
+        nothing evicted was ever dropped."""
+        tiered, _ = build_tiered(4 * CFG.capacity // 2)
+        segs = tiered.archive.snapshot()
+        assert segs, "4x ring turnover must have captured"
+        assert segs[0].gid_lo == 0
+        for a, b in zip(segs, segs[1:]):
+            assert a.gid_hi == b.gid_lo
+        assert segs[-1].gid_hi == tiered.hot._cap_upto
+        assert sum(s.n_spans for s in segs) == tiered.hot._cap_upto
+
+    def test_captured_spans_are_complete(self):
+        """The annotation ring laps ~2.5x faster than the span ring at
+        these shapes; the three-ring trigger must capture BEFORE side
+        rows evict, so every cold span decodes with its full
+        annotation set (the oracle comparison covers content)."""
+        tiered, oracle = build_tiered(4 * CFG.capacity // 2)
+        seg = tiered.archive.snapshot()[0]
+        _, _, spans = tiered.archive.decoded(seg)
+        by_key = {}
+        for s in oracle.spans:
+            by_key[(s.trace_id, s.id)] = s
+        for s in spans[:200]:
+            assert s == by_key[(s.trace_id, s.id)]
+
+
+class TestCaptureHardening:
+    def test_annotation_heavy_chained_writes_stay_complete(self):
+        """Chained multi-chunk launches are bounded by HALF of every
+        ring: without the annotation budget, four 64-span chunks of
+        32-annotation spans (8192 ann rows) would chain into ONE
+        launch over a 2048-row annotation ring — overwriting their own
+        side rows mid-launch where no capture hook can run. Evicted
+        spans must still decode with their full annotation sets."""
+        cfg = StoreConfig(
+            capacity=1 << 9, ann_capacity=1 << 11,
+            bann_capacity=1 << 9, max_services=8, max_span_names=16,
+            max_annotation_values=64, max_binary_keys=8,
+            cms_width=1 << 8, hll_p=6, quantile_buckets=128,
+        )
+        hot = TpuSpanStore(cfg)
+        tiered = TieredSpanStore(hot, params=ArchiveParams.for_config(
+            cfg, compact_fanin=2, small_span_limit=cfg.capacity,
+            bloom_bits=1 << 12, cms_width=1 << 9, hll_p=6))
+        oracle = InMemorySpanStore()
+        n = 2 * cfg.capacity
+        spans = [
+            Span(tid, "fat", tid, None, tuple(
+                [Annotation(1000 + 100 * tid, "sr", API)]
+                + [Annotation(1000 + 100 * tid + i, "custom", API)
+                   for i in range(31)]
+            ), ())
+            for tid in range(1, n + 1)
+        ]
+        for i in range(0, n, 256):
+            tiered.apply(spans[i:i + 256])
+            oracle.apply(spans[i:i + 256])
+        assert tiered.counters()["archive_segments_written"] >= 1
+        for tid in (1, 2, n // 2, n):
+            assert (tiered.get_spans_by_trace_ids([tid])
+                    == oracle.get_spans_by_trace_ids([tid])), tid
+
+    def test_transient_pull_failure_is_retried_not_skipped(self,
+                                                          monkeypatch):
+        """The capture clocks advance only AFTER the pull + seal
+        succeed: a transient device error must leave the window
+        uncaptured-but-resident so the retried write captures it —
+        stamping first would skip those gids forever."""
+        hot = TpuSpanStore(CFG)
+        tiered = TieredSpanStore(hot, params=PARAMS)
+        oracle = InMemorySpanStore()
+        real_pull = TpuSpanStore._pull_evicted_rows
+        state = {"fail": 1}
+
+        def flaky(self, *a, **kw):
+            if state["fail"] > 0:
+                state["fail"] -= 1
+                raise TimeoutError("simulated wedged capture pull")
+            return real_pull(self, *a, **kw)
+
+        monkeypatch.setattr(TpuSpanStore, "_pull_evicted_rows", flaky)
+        n = 2 * CFG.capacity // 2
+        failed_batch = None
+        for tid in range(1, n + 1):
+            batch = make_trace(tid)
+            try:
+                tiered.apply(batch)
+            except TimeoutError:
+                failed_batch = batch  # aborted write: retry it
+                tiered.apply(batch)
+            oracle.apply(batch)
+        assert failed_batch is not None, "the fault never fired"
+        # Coverage stayed contiguous and answers exact.
+        segs = tiered.archive.snapshot()
+        assert segs and segs[0].gid_lo == 0
+        for a, b in zip(segs, segs[1:]):
+            assert a.gid_hi == b.gid_lo
+        for tid in (1, 2, n):
+            assert (tiered.get_spans_by_trace_ids([tid])
+                    == oracle.get_spans_by_trace_ids([tid])), tid
+
+
+class TestTieredConformance:
+    """The acceptance drive (ISSUE 3): 4x ring capacity, answers match
+    the memory-store oracle exactly, including evicted spans."""
+
+    @pytest.fixture(scope="class")
+    def stores(self):
+        n_traces = 4 * CFG.capacity // 2  # 2 spans/trace -> 4x ring
+        return build_tiered(n_traces)
+
+    def test_ring_turned_over_and_segments_exist(self, stores):
+        tiered, _ = stores
+        counters = tiered.counters()
+        assert counters["ring_laps"] >= 3
+        assert counters["archive_segments_written"] >= 1
+        assert counters["archive_compactions"] >= 1
+
+    def test_trace_fetch_matches_oracle_incl_evicted(self, stores):
+        tiered, oracle = stores
+        n = 4 * CFG.capacity // 2
+        sample = [1, 2, 3, n // 4, n // 2, n - 1, n]
+        for tid in sample:
+            assert (tiered.get_spans_by_trace_ids([tid])
+                    == oracle.get_spans_by_trace_ids([tid])), tid
+        # Batched form too, mixed found/missing.
+        assert (tiered.get_spans_by_trace_ids(sample + [10 ** 12])
+                == oracle.get_spans_by_trace_ids(sample + [10 ** 12]))
+
+    def test_trace_ids_match_oracle_full_range(self, stores):
+        tiered, oracle = stores
+        end_ts = 1 << 60
+        big = 10 * 4 * CFG.capacity
+        for q in (
+            ("web", "index"), ("web", None), ("api", "lookup"),
+            ("db", None),
+        ):
+            got = tiered.get_trace_ids_by_name(q[0], q[1], end_ts, big)
+            want = oracle.get_trace_ids_by_name(q[0], q[1], end_ts, big)
+            assert got == want, q
+        for q in (
+            ("api", "boom", None), ("api", "k", b"v1"),
+            ("api", "k", None),
+        ):
+            got = tiered.get_trace_ids_by_annotation(
+                q[0], q[1], q[2], end_ts, big)
+            want = oracle.get_trace_ids_by_annotation(
+                q[0], q[1], q[2], end_ts, big)
+            assert got == want, q
+
+    def test_trace_ids_limit_union_is_exact(self, stores):
+        """Small limits exercise the cross-tier top-k union proof."""
+        tiered, oracle = stores
+        end_ts = 1 << 60
+        for limit in (1, 3, 10):
+            got = tiered.get_trace_ids_by_name("web", None, end_ts,
+                                               limit)
+            want = oracle.get_trace_ids_by_name("web", None, end_ts,
+                                                limit)
+            assert got == want, limit
+
+    def test_exist_and_durations_match_oracle(self, stores):
+        tiered, oracle = stores
+        n = 4 * CFG.capacity // 2
+        qt = [1, 2, n // 2, n, 10 ** 12]
+        assert tiered.traces_exist(qt) == oracle.traces_exist(qt)
+        assert (tiered.get_traces_duration(qt)
+                == oracle.get_traces_duration(qt))
+
+    def test_dependencies_match_oracle(self, stores):
+        from zipkin_tpu.aggregate.job import aggregate_spans
+
+        tiered, oracle = stores
+        want = {
+            (l.parent, l.child): l.duration_moments.count
+            for l in aggregate_spans(oracle.spans).links
+        }
+        got = {
+            (l.parent, l.child): l.duration_moments.count
+            for l in tiered.get_dependencies().links
+        }
+        assert got == want
+
+    def test_duration_quantiles_match_oracle(self, stores):
+        from zipkin_tpu.ops.quantile import quantiles_host
+
+        tiered, oracle = stores
+        gamma = (1.0 + CFG.quantile_alpha) / (1.0 - CFG.quantile_alpha)
+        qs = [0.5, 0.95, 0.99]
+        for svc in ("api", "db"):
+            counts = np.zeros(CFG.quantile_buckets, np.int64)
+            durs = [
+                s.duration for s in oracle.spans
+                if s.service_name == svc and s.duration is not None
+            ]
+            SK.hist_add(counts, np.asarray(durs, np.int64), gamma)
+            want = quantiles_host(counts, gamma, 1.0, qs)
+            got = tiered.service_duration_quantiles(svc, qs)
+            assert got == want, svc
+
+    def test_cold_sketches_answer_without_rows(self, stores):
+        tiered, _ = stores
+        cold_q = tiered.cold_duration_quantiles("api", [0.5, 0.99])
+        assert cold_q is not None and all(v == v for v in cold_q)
+        est = tiered.cold_estimated_unique_traces()
+        cold_spans = tiered.counters()["archive_cold_spans"]
+        assert 0.3 * cold_spans / 2 <= est  # 2 spans per trace
+
+    def test_zone_map_prunes_narrow_time_range(self, stores):
+        tiered, oracle = stores
+        before = tiered.archive.c_pruned.value
+        # The earliest traces' window: every later segment's minimum
+        # last-ts exceeds this end_ts and must be skipped unread.
+        got = tiered.get_trace_ids_by_name("web", None, 1_400, 50)
+        want = oracle.get_trace_ids_by_name("web", None, 1_400, 50)
+        assert got == want
+        assert tiered.archive.c_pruned.value > before
+
+    def test_counters_and_registry_metrics(self, stores):
+        tiered, _ = stores
+        c = tiered.counters()
+        assert c["archive_segments_written"] >= 1
+        assert c["archive_compactions"] >= 1
+        assert c["archive_cold_spans"] > 0
+        assert c["archive_captures"] >= 1
+        assert c["archive_cold_bytes"] < c["archive_cold_raw_bytes"]
+
+
+class TestTieredMisc:
+    def test_multi_matches_singular(self):
+        tiered, oracle = build_tiered(CFG.capacity)
+        end_ts = 1 << 60
+        queries = [
+            ("name", "web", "index", end_ts, 20),
+            ("name", "db", None, end_ts, 10),
+            ("annotation", "api", "boom", None, end_ts, 20),
+        ]
+        got = tiered.get_trace_ids_multi(queries)
+        assert got[0] == oracle.get_trace_ids_by_name(
+            "web", "index", end_ts, 20)
+        assert got[1] == oracle.get_trace_ids_by_name(
+            "db", None, end_ts, 10)
+        assert got[2] == oracle.get_trace_ids_by_annotation(
+            "api", "boom", None, end_ts, 20)
+
+    def test_service_and_span_name_catalogs(self):
+        tiered, oracle = build_tiered(CFG.capacity)
+        assert (tiered.get_all_service_names()
+                == oracle.get_all_service_names())
+        for svc in ("web", "api", "db"):
+            assert (tiered.get_span_names(svc)
+                    == oracle.get_span_names(svc)), svc
+
+    def test_pin_through_tiers_banks_cold_rows(self):
+        tiered, oracle = build_tiered(2 * CFG.capacity)
+        # Trace 1 is long evicted from the ring; pinning must bank its
+        # cold rows (the pre-cold-tier pin path could only bank what
+        # the ring still held).
+        tiered.set_time_to_live(1, 3600.0)
+        assert tiered.hot.pins.get(1)
+        assert (tiered.get_spans_by_trace_ids([1])
+                == oracle.get_spans_by_trace_ids([1]))
+
+    def test_capture_now_flushes_resident_window(self):
+        tiered, oracle = build_tiered(CFG.capacity // 4)  # no wrap yet
+        assert len(tiered.archive) == 0
+        tiered.capture_now()
+        assert len(tiered.archive) >= 1
+        segs = tiered.archive.snapshot()
+        assert segs[-1].gid_hi == tiered.hot._wp
+        # Overlapping tiers still answer exactly (gid dedupe).
+        assert (tiered.get_spans_by_trace_ids([1])
+                == oracle.get_spans_by_trace_ids([1]))
+
+
+def test_tiered_checkpoint_roundtrip(tmp_path):
+    from zipkin_tpu import checkpoint
+
+    tiered, oracle = build_tiered(3 * CFG.capacity // 2)
+    path = str(tmp_path / "ckpt")
+    checkpoint.save(tiered, path)
+    restored = checkpoint.load(path)
+    assert isinstance(restored, TieredSpanStore)
+    n = 3 * CFG.capacity // 2
+    for tid in (1, n // 2, n):
+        assert (restored.get_spans_by_trace_ids([tid])
+                == oracle.get_spans_by_trace_ids([tid])), tid
+    end_ts = 1 << 60
+    assert (restored.get_trace_ids_by_name("web", None, end_ts, 10 * n)
+            == oracle.get_trace_ids_by_name("web", None, end_ts,
+                                            10 * n))
+    # Post-restore ingest keeps capturing.
+    extra = make_trace(10 ** 6)
+    restored.apply(extra)
+    oracle.apply(extra)
+    assert (restored.get_spans_by_trace_ids([10 ** 6])
+            == oracle.get_spans_by_trace_ids([10 ** 6]))
+
+
+@pytest.mark.parametrize("name", conformance_test_names())
+def test_tiered_store_conformance(name):
+    """The SpanStoreValidator suite straight over the tiered store —
+    the federation is a SpanStore like any other backend."""
+    def factory():
+        return TieredSpanStore(TpuSpanStore(CFG), params=PARAMS)
+
+    run_conformance_test(name, factory)

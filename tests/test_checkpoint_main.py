@@ -145,7 +145,11 @@ class TestCheckpoint:
         assert __import__("os").path.isdir(staging)
         assert not __import__("os").path.isdir(path)  # nothing partial
 
-        # Retry with a healthy tunnel: staged leaves are reused.
+        # Retry with a healthy tunnel: staged leaves are reused. The
+        # simulated wedge carried no orphan thread, so the suspect
+        # stamp needs the operator override (a real timeout's orphan
+        # finishes and ensure_writable clears the flag itself).
+        store.clear_suspect()
         monkeypatch.setattr(checkpoint, "_bounded_get", real_get)
         stats = checkpoint.save(store, path, chunk_deadline_s=5.0)
         assert stats["resumed_leaves"] > 0
@@ -177,6 +181,7 @@ class TestCheckpoint:
         with pytest.raises(TimeoutError):
             checkpoint.save(store, path, chunk_deadline_s=5.0,
                             slab_retries=0)
+        store.clear_suspect()  # simulated wedge: no orphan to join
         monkeypatch.setattr(checkpoint, "_bounded_get", real_get)
         store.apply([rpc(2, 3, None, 300, 400)])  # generation changes
         stats = checkpoint.save(store, path, chunk_deadline_s=5.0)
@@ -210,6 +215,7 @@ class TestCheckpoint:
         with pytest.raises(TimeoutError):
             checkpoint.save(store, path, chunk_deadline_s=5.0,
                             slab_retries=0)
+        store.clear_suspect()  # simulated wedge: no orphan to join
         monkeypatch.setattr(checkpoint, "_bounded_get", real_get)
         before = int(store.counters()["sweeps"])
         store.get_dependencies()  # triggers the pending sweep
@@ -221,6 +227,67 @@ class TestCheckpoint:
                for l in restored.get_dependencies().links}
         assert got == {(l.parent, l.child)
                        for l in store.get_dependencies().links}
+
+    def test_wedged_slab_fails_fast_with_bounded_lock_hold(
+            self, tmp_path, monkeypatch):
+        """ADVICE r5 #2 regression: the FIRST slab timeout must fail
+        the save immediately — no retry/backoff while the
+        writer-blocking read lock is held (the retry enqueues behind
+        the wedged transfer and can never succeed until it clears, so
+        it only ever extended the ingest stall). A slow fake device
+        wedges every transfer after the first few; the save must
+        return within ~one deadline (no backoff sleeps, no second
+        attempt), stamp the store suspect, and leave the staged leaves
+        for the resume path."""
+        import os
+        import time
+
+        store = TpuSpanStore(CFG)
+        store.apply([rpc(1, 1, None, 100, 200)])
+        path = str(tmp_path / "ckpt")
+
+        deadline = 0.3
+        real_get = checkpoint._bounded_get
+        calls = {"n": 0, "wedged": 0}
+
+        def slow_device(x, deadline_s):
+            calls["n"] += 1
+            if deadline_s is not None and calls["n"] > 3:
+                # Slow fake device: block for the full deadline the
+                # way a wedged tunnel does, then surface the timeout.
+                calls["wedged"] += 1
+                time.sleep(deadline_s)
+                err = TimeoutError("simulated slow device")
+                raise err
+            return real_get(x, None)
+
+        monkeypatch.setattr(checkpoint, "_bounded_get", slow_device)
+        t0 = time.perf_counter()
+        with pytest.raises(TimeoutError):
+            # slab_retries is deliberately > 0: fail-fast must ignore
+            # it (the parameter is kept for call-site compatibility).
+            checkpoint.save(store, path, chunk_deadline_s=deadline,
+                            slab_retries=5)
+        held = time.perf_counter() - t0
+        # Exactly ONE wedged transfer was attempted — no retries — so
+        # the lock hold is bounded by one deadline plus the healthy
+        # leaves' transfer time, far below even a single retry cycle
+        # (deadline + backoff + deadline).
+        assert calls["wedged"] == 1
+        assert held < 2 * deadline + 5.0
+        # The store is stamped suspect (orphan bookkeeping) and the
+        # staged leaves survived for the resume.
+        assert store.suspect
+        assert os.path.isdir(path + ".staging")
+        # Resume with a healthy device completes and clears nothing
+        # it shouldn't: the snapshot restores.
+        monkeypatch.setattr(checkpoint, "_bounded_get", real_get)
+        store.clear_suspect()
+        stats = checkpoint.save(store, path, chunk_deadline_s=5.0)
+        assert stats["resumed_leaves"] > 0
+        restored = checkpoint.load(path)
+        assert restored.get_spans_by_trace_ids([1]) == \
+            store.get_spans_by_trace_ids([1])
 
     def test_chunked_save_slabs_large_leaves(self, tmp_path,
                                              monkeypatch):

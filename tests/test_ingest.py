@@ -358,7 +358,10 @@ class TestKafkaSink:
                  for s in t][:3]
         for s in spans:
             sink.apply([s])
-        assert sink.stats == {"published": 2, "errors": 1}
+        assert sink.stats["published"] == 2
+        assert sink.stats["errors"] == 1
+        # Uncompressed sink: wire bytes are the raw payload bytes.
+        assert sink.stats["bytes_wire"] == sink.stats["bytes_raw"] > 0
 
 
 def test_kafka_record_value_stream_adapts_both_shapes():

@@ -301,6 +301,14 @@ class ApiServer:
                 if any(v != v for v in vals):
                     vals = None
             return 200, {"quantiles": qs, "durationsMicro": vals}
+        if path == "/api/span_durations":
+            return self._span_durations(params)
+        if path == "/api/service_names_to_trace_ids":
+            return self._service_names_to_trace_ids(params)
+        if path == "/api/data_ttl":
+            return 200, {
+                "dataTimeToLive": self.query.get_data_time_to_live()
+            }
         if path == "/api/dependencies" or re.match(r"^/api/dependencies/", path):
             return self._dependencies(path, params)
         if path == "/api/traces_exist":
@@ -424,6 +432,54 @@ class ApiServer:
             ],
         }
 
+    @staticmethod
+    def _slice_params(params):
+        """(timeStamp, serviceName, spanName) for the thrift slice
+        methods: timeStamp defaults to 'everything so far' and spanName
+        'all' means no rpc-name restriction (the query-extractor
+        convention)."""
+        ts_raw = params.get("timeStamp") or params.get("endTs")
+        time_stamp = int(ts_raw) if ts_raw else (1 << 62)
+        span_name = params.get("spanName")
+        if span_name == "all":
+            span_name = None
+        return time_stamp, params.get("serviceName"), span_name
+
+    def _span_durations(self, params):
+        """getSpanDurations (zipkinQuery.thrift) over HTTP: durations
+        (µs) of spans named spanName, grouped by owning service."""
+        time_stamp, service, span_name = self._slice_params(params)
+        if not service:
+            raise QueryException("serviceName is required")
+        if not span_name:
+            # Distinguish absent from the explicit "all" wildcard —
+            # getSpanDurations has no all-spans form, so the wildcard
+            # gets an accurate rejection, not "required".
+            if params.get("spanName") == "all":
+                raise QueryException(
+                    "spanName must name a specific span "
+                    "(getSpanDurations has no 'all' form)")
+            raise QueryException("spanName is required")
+        return 200, {
+            "durations": self.query.get_span_durations(
+                time_stamp, service, span_name)
+        }
+
+    def _service_names_to_trace_ids(self, params):
+        """getServiceNamesToTraceIds (zipkinQuery.thrift) over HTTP:
+        participating service name -> unsigned-hex trace ids."""
+        time_stamp, service, span_name = self._slice_params(params)
+        if not service:
+            raise QueryException("serviceName is required")
+        mapping = self.query.get_service_names_to_trace_ids(
+            time_stamp, service, span_name)
+        return 200, {
+            "serviceNames": {
+                svc: [_hex_id(t) for t in tids]
+                for svc, tids in sorted(mapping.items())
+            }
+        }
+
     def _traces_exist(self, params):
         """tracesExist (zipkinQuery.thrift:154): which of the queried
         ids have ANY stored span — the cheap batched membership probe
@@ -526,7 +582,8 @@ _KNOWN_ROUTES = frozenset((
     "/debug/profile", "/api/query", "/api/services", "/api/spans",
     "/api/v1/spans", "/api/top_annotations", "/api/top_kv_annotations",
     "/api/quantiles", "/api/dependencies", "/api/traces_exist",
-    "/scribe",
+    "/api/span_durations", "/api/service_names_to_trace_ids",
+    "/api/data_ttl", "/scribe",
 ))
 
 

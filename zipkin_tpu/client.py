@@ -272,3 +272,30 @@ class QueryClient:
             for t in trace_ids
         )
         return self._get(f"/api/traces_exist?traceIds={ids}")["exist"]
+
+    def span_durations(self, service: str, span_name: str,
+                       time_stamp: Optional[int] = None) -> Dict:
+        """getSpanDurations: {service name: [duration µs, ...]} for
+        spans named ``span_name`` in traces the index matches."""
+        qs = f"serviceName={service}&spanName={span_name}"
+        if time_stamp is not None:
+            qs += f"&timeStamp={time_stamp}"
+        return self._get(f"/api/span_durations?{qs}")["durations"]
+
+    def service_names_to_trace_ids(self, service: str,
+                                   span_name: Optional[str] = None,
+                                   time_stamp: Optional[int] = None
+                                   ) -> Dict:
+        """getServiceNamesToTraceIds: {participating service:
+        [unsigned-hex trace ids]}."""
+        qs = f"serviceName={service}"
+        if span_name is not None:
+            qs += f"&spanName={span_name}"
+        if time_stamp is not None:
+            qs += f"&timeStamp={time_stamp}"
+        return self._get(
+            f"/api/service_names_to_trace_ids?{qs}")["serviceNames"]
+
+    def data_ttl(self) -> int:
+        """getDataTimeToLive: the storage tier's retention (seconds)."""
+        return self._get("/api/data_ttl")["dataTimeToLive"]

@@ -61,11 +61,51 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from typing import Callable, Iterable, List, Optional, Sequence
 
 from zipkin_tpu.ingest.queue import QueueFullException
 from zipkin_tpu.models.span import Span
 from zipkin_tpu.wire.thrift import ThriftError, spans_from_bytes
+
+# Wire-path compression framing: an optional ONE-BYTE negotiation
+# prefix on each message value. 0x01 = the rest is a raw-deflate
+# (zlib) stream of concatenated thrift Span structs; 0x00 = the rest
+# is those structs uncompressed (framed but not worth compressing).
+# Any other first byte is a LEGACY unframed payload: a TBinaryProtocol
+# Span struct always starts with a field-type byte >= 0x02 (trace_id
+# i64 => 0x0a), so the two framed markers can never collide with real
+# spans — old producers and new consumers interoperate byte-for-byte.
+FRAME_DEFLATE = 0x01
+FRAME_RAW = 0x00
+# Tiny payloads inflate under deflate (header + dictionary overhead);
+# below this the sink ships the framed-raw form instead.
+COMPRESS_MIN_BYTES = 128
+
+
+def encode_frame(payload: bytes, compress: bool,
+                 min_bytes: int = COMPRESS_MIN_BYTES) -> bytes:
+    if not compress:
+        return payload  # legacy unframed (backward compatible)
+    if len(payload) < min_bytes:
+        return bytes([FRAME_RAW]) + payload
+    return bytes([FRAME_DEFLATE]) + zlib.compress(payload, 6)
+
+
+def decode_frame(message: bytes) -> bytes:
+    """Unframe a message value; raises ThriftError on a corrupt
+    deflate stream (counted like any bad payload, never fatal)."""
+    if not message:
+        return message
+    marker = message[0]
+    if marker == FRAME_DEFLATE:
+        try:
+            return zlib.decompress(message[1:])
+        except zlib.error as e:
+            raise ThriftError(f"bad deflate frame: {e}") from e
+    if marker == FRAME_RAW:
+        return message[1:]
+    return message  # legacy unframed
 
 
 class KafkaSpanReceiver:
@@ -96,6 +136,16 @@ class KafkaSpanReceiver:
     def _drain(self, stream: Iterable[bytes]) -> None:
         for message in stream:
             self.stats["messages"] += 1
+            if not message:
+                continue
+            try:
+                # Negotiation byte first: framed-deflate payloads
+                # decompress here, framed-raw strip the marker, and
+                # legacy unframed bytes pass through untouched.
+                message = decode_frame(message)
+            except ThriftError:
+                self.stats["bad"] += 1
+                continue
             if not message:
                 continue
             if self.process_thrift is not None:
@@ -153,14 +203,23 @@ class KafkaSpanSink:
 
     def __init__(self, producer: Callable[[str, bytes], object],
                  topic: str = "zipkin",
-                 batch: bool = False):
+                 batch: bool = False,
+                 compress: bool = False,
+                 compress_min_bytes: int = COMPRESS_MIN_BYTES):
         from zipkin_tpu.wire.thrift import span_to_bytes
 
         self._encode = span_to_bytes
         self.producer = producer
         self.topic = topic
         self.batch = batch
-        self.stats = {"published": 0, "errors": 0}
+        # ``compress`` turns on the negotiation-byte framing (see
+        # encode_frame): deflate for payloads past compress_min_bytes,
+        # framed-raw below it. Off by default — unframed output stays
+        # byte-identical for legacy consumers.
+        self.compress = compress
+        self.compress_min_bytes = compress_min_bytes
+        self.stats = {"published": 0, "errors": 0,
+                      "bytes_raw": 0, "bytes_wire": 0}
         # Async producers report delivery on their returned future from
         # an IO thread; counters need the lock either way.
         self._stats_lock = threading.Lock()
@@ -180,8 +239,12 @@ class KafkaSpanSink:
             self._send(self._encode(s), 1)
 
     def _send(self, payload: bytes, n: int) -> None:
+        wire = encode_frame(payload, self.compress,
+                            self.compress_min_bytes)
+        self._count("bytes_raw", len(payload))
+        self._count("bytes_wire", len(wire))
         try:
-            result = self.producer(self.topic, payload)
+            result = self.producer(self.topic, wire)
         except Exception:
             # The reference sink swallows-and-counts producer errors
             # rather than failing the write pipeline.

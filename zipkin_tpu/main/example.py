@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the per-ingest-step zipkin-tpu self "
                         "spans (API-request self-tracing stays on; "
                         "see docs/OBSERVABILITY.md)")
+    p.add_argument("--cold-tier", action="store_true",
+                   help="capture ring evictions into the compressed "
+                        "segment archive and federate queries across "
+                        "hot + cold (store/archive; single-device "
+                        "stores only)")
     p.add_argument("--seed-traces", type=int, default=0,
                    help="generate N synthetic traces at startup")
     p.add_argument("--checkpoint", default=None,
@@ -112,6 +117,21 @@ def build_app(args):
             from zipkin_tpu.store.tpu import TpuSpanStore
 
             store = TpuSpanStore(StoreConfig(capacity=args.capacity))
+    if args.cold_tier:
+        if hasattr(store, "archive"):
+            # Restored tiered checkpoint: already wrapped, but the
+            # daemon still wants compaction off the ingest write path.
+            store.archive.start_compactor()
+        else:
+            if args.memory_store or getattr(store, "n", 0):
+                raise SystemExit(
+                    "--cold-tier requires the single-device store "
+                    "(the sharded store's per-shard capture is not "
+                    "wired yet)"
+                )
+            from zipkin_tpu.store.archive import TieredSpanStore
+
+            store = TieredSpanStore(store, background_compaction=True)
     adaptive = (
         AdaptiveConfig(target_store_rate=args.adaptive_target)
         if args.adaptive_target > 0 else None

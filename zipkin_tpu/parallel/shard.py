@@ -23,6 +23,20 @@ from zipkin_tpu.store import device as dev
 from zipkin_tpu.store.base import service_scan_only
 
 
+def compat_shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """shard_map across jax versions: the promoted ``jax.shard_map``
+    (with its ``check_vma`` flag) when present, else the
+    ``jax.experimental.shard_map`` this environment ships (same
+    semantics; the flag was named ``check_rep`` there)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def _stack_states(config: dev.StoreConfig, n: int):
     one = dev.init_state(config)
     return jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + x.shape), one)
@@ -99,7 +113,7 @@ def make_sharded_archive(mesh: Mesh, axis: str = "shard"):
         new_state = dev.dep_close_bucket.__wrapped__(state)
         return jax.tree.map(lambda x: x[None], new_state)
 
-    mapped = jax.shard_map(
+    mapped = compat_shard_map(
         fn, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(axis),
         check_vma=False,
     )
@@ -115,7 +129,7 @@ def make_sharded_sweep(mesh: Mesh, axis: str = "shard"):
         new_state = dev.dep_sweep.__wrapped__(state)
         return jax.tree.map(lambda x: x[None], new_state)
 
-    mapped = jax.shard_map(
+    mapped = compat_shard_map(
         fn, mesh=mesh, in_specs=(P(axis),), out_specs=P(axis),
         check_vma=False,
     )
@@ -138,7 +152,7 @@ def make_sharded_ingest(mesh: Mesh, axis: str = "shard"):
         new_state = jax.tree.map(lambda x: x[None], new_state)
         return new_state, summary
 
-    mapped = jax.shard_map(
+    mapped = compat_shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),
@@ -216,7 +230,7 @@ def global_summary(states, mesh: Mesh, axis: str = "shard",
         state = jax.tree.map(lambda x: x[0], state)
         return _summarize(state, axis, dep_k)
 
-    mapped = jax.shard_map(
+    mapped = compat_shard_map(
         fn, mesh=mesh, in_specs=(P(axis),), out_specs=P(), check_vma=False
     )
     return jax.jit(mapped)(states)
@@ -420,7 +434,7 @@ class ShardedSpanStore(SuspectGuard):
                 )
                 return mat[None]
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh,
                 in_specs=(P(self.axis), P(), P(), P()),
                 out_specs=P(self.axis), check_vma=False,
@@ -459,7 +473,7 @@ class ShardedSpanStore(SuspectGuard):
                     )
                 return mat[None], complete[None], wm[None]
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh,
                 in_specs=(P(self.axis), P(), P(), P()),
                 out_specs=(P(self.axis),) * 3, check_vma=False,
@@ -517,7 +531,7 @@ class ShardedSpanStore(SuspectGuard):
                     )
                 return mat[None], complete[None], wm[None]
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh,
                 in_specs=(P(self.axis),) + (P(),) * 6,
                 out_specs=(P(self.axis),) * 3, check_vma=False,
@@ -534,7 +548,7 @@ class ShardedSpanStore(SuspectGuard):
                 )
                 return mat[None]
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh,
                 in_specs=(P(self.axis),) + (P(),) * 6,
                 out_specs=P(self.axis), check_vma=False,
@@ -554,7 +568,7 @@ class ShardedSpanStore(SuspectGuard):
                     jax.lax.pmax(mat[3], self.axis),
                 ])
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
                 out_specs=P(), check_vma=False,
             ))
@@ -581,7 +595,7 @@ class ShardedSpanStore(SuspectGuard):
                 )
                 return merged, all_exact
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
                 out_specs=(P(), P()), check_vma=False,
             ))
@@ -613,7 +627,7 @@ class ShardedSpanStore(SuspectGuard):
                 )
                 return counts[None], s[None], a[None], b[None], all_exact
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
                 out_specs=(P(self.axis),) * 4 + (P(),), check_vma=False,
             ))
@@ -645,7 +659,7 @@ class ShardedSpanStore(SuspectGuard):
                 )
                 return counts[None], s[None], a[None], b[None]
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
                 out_specs=P(self.axis), check_vma=False,
             ))
@@ -667,7 +681,7 @@ class ShardedSpanStore(SuspectGuard):
                                         self.axis)
                 return jax.lax.psum(getattr(st, key), self.axis)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis),),
                 out_specs=P(), check_vma=False,
             ))
@@ -832,7 +846,7 @@ class ShardedSpanStore(SuspectGuard):
                 )
                 return mat[None], complete[None], wm[None]
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh,
                 in_specs=(P(self.axis),) + (P(),) * 11,
                 out_specs=(P(self.axis),) * 3, check_vma=False,
@@ -1021,7 +1035,7 @@ class ShardedSpanStore(SuspectGuard):
                     return jax.lax.psum(
                         pres.astype(jnp.int32), self.axis) > 0
 
-                return jax.jit(jax.shard_map(
+                return jax.jit(compat_shard_map(
                     fn, mesh=self.mesh, in_specs=(P(self.axis),),
                     out_specs=P(), check_vma=False,
                 ))
@@ -1049,7 +1063,7 @@ class ShardedSpanStore(SuspectGuard):
                 rows = dev.svc_scan_catalog(st, svc)
                 return tuple(jax.lax.psum(r, self.axis) for r in rows)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis), P()),
                 out_specs=(P(),) * 4, check_vma=False,
             ))
@@ -1090,7 +1104,7 @@ class ShardedSpanStore(SuspectGuard):
             def fn(state):
                 return _summarize(self._unstack(state), self.axis)
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis),),
                 out_specs=P(), check_vma=False,
             ))
@@ -1112,7 +1126,7 @@ class ShardedSpanStore(SuspectGuard):
                                      end_ts)
                 return M.reduce_moments(banks, axis=0), ts_min, ts_max
 
-            return jax.jit(jax.shard_map(
+            return jax.jit(compat_shard_map(
                 fn, mesh=self.mesh, in_specs=(P(self.axis), P(), P()),
                 out_specs=(P(), P(), P()), check_vma=False,
             ))

@@ -263,6 +263,83 @@ class QueryService:
     def get_trace_time_to_live(self, trace_id: int) -> float:
         return self.store.get_time_to_live(trace_id)
 
+    # -- remaining thrift surface (zipkinQuery.thrift) -----------------
+
+    # Candidate window for the duration/service aggregation methods —
+    # the reference aggregates over the traces its index returns for
+    # the slice, bounded like any index read.
+    SLICE_AGG_LIMIT = 100
+
+    def _slice_trace_spans(self, time_stamp: int, service_name: str,
+                           rpc_name: Optional[str], limit: int):
+        """Traces matched by the (service, rpc) name index at or before
+        ``time_stamp`` — the shared fetch behind getSpanDurations and
+        getServiceNamesToTraceIds. Rides the coalescer like every other
+        trace-id lookup."""
+        if not service_name:
+            raise QueryException("No service name provided")
+        ids = self._multi([
+            ("name", service_name, rpc_name, time_stamp, limit)
+        ])[0]
+        return self.store.get_spans_by_trace_ids(
+            [i.trace_id for i in ids])
+
+    def get_span_durations(self, time_stamp: int, service_name: str,
+                           rpc_name: str,
+                           limit: Optional[int] = None
+                           ) -> Dict[str, List[int]]:
+        """``getSpanDurations(time_stamp, server_service_name,
+        rpc_name)`` (zipkinQuery.thrift): for the traces the name index
+        matches, the durations (µs) of every span named ``rpc_name``,
+        grouped by the span's owning service — the data behind the
+        reference's duration-histogram aggregation page."""
+        wanted = rpc_name.lower()
+        out: Dict[str, List[int]] = {}
+        for spans in self._slice_trace_spans(
+                time_stamp, service_name, rpc_name,
+                limit or self.SLICE_AGG_LIMIT):
+            for s in spans:
+                if s.name.lower() != wanted or s.duration is None:
+                    continue
+                svc = s.service_name
+                if svc is not None:
+                    out.setdefault(svc.lower(), []).append(s.duration)
+        return out
+
+    def get_service_names_to_trace_ids(self, time_stamp: int,
+                                       service_name: str,
+                                       rpc_name: Optional[str],
+                                       limit: Optional[int] = None
+                                       ) -> Dict[str, List[int]]:
+        """``getServiceNamesToTraceIds`` (zipkinQuery.thrift): for the
+        traces the (service, rpc) index matches, every service name
+        participating in each trace, mapped to the trace ids it appears
+        in — the cross-service fan-out view."""
+        out: Dict[str, List[int]] = {}
+        for spans in self._slice_trace_spans(
+                time_stamp, service_name, rpc_name,
+                limit or self.SLICE_AGG_LIMIT):
+            if not spans:
+                continue
+            tid = spans[0].trace_id
+            names = set()
+            for s in spans:
+                names.update(s.service_names)
+            for n in sorted(names):
+                out.setdefault(n, []).append(tid)
+        return out
+
+    def get_data_time_to_live(self) -> int:
+        """``getDataTimeToLive`` (zipkinQuery.thrift): the storage
+        tier's span retention in seconds. Backends with a configured
+        TTL expose ``data_ttl_s``; the device ring (eviction-retained)
+        and the reference default both answer the Cassandra span TTL
+        (CassieSpanStore.scala:47)."""
+        from zipkin_tpu.store.base import DEFAULT_SPAN_TTL_S
+
+        ttl = getattr(self.store, "data_ttl_s", None)
+        return int(ttl if ttl is not None else DEFAULT_SPAN_TTL_S)
+
 
 def _intersect(per_slice: List[List[IndexedTraceId]]) -> List[IndexedTraceId]:
     """Ids present in every slice, stamped with their max timestamp

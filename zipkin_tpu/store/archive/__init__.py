@@ -1,0 +1,46 @@
+"""Cold-tier segment store: capture ring evictions into immutable
+compressed columnar segments with sketch zone-maps, and federate
+queries across the hot device ring and the cold segments.
+
+The reference keeps the full TTL window in Cassandra behind the same
+SpanStore trait; the TPU build's device ring holds ~2^22 rows and laps
+hundreds of times during a 1B-span run — every overwritten span was
+gone forever. This package is the TPU-native equivalent of the warm
+backend: a host-side tier built from the repo's own mergeable sketches
+(per-segment moment/quantile summaries in the spirit of
+arXiv:1803.01969; time/space sketch disaggregation, arXiv:2503.13515),
+so cold segments answer aggregate and pruning questions without
+decompressing rows.
+
+- ``sketches`` — numpy twins of the ops/ hash + sketch primitives
+  (bloom / CMS / HLL / log-histogram), all mergeable monoids.
+- ``segment`` — the immutable segment format: deflate-compressed
+  SpanBatch column planes + a zone-map header.
+- ``directory`` — the segment list + the background compactor that
+  merges small segments (zone maps merge monoidally, no re-scan).
+- ``tiered`` — ``TieredSpanStore``: the full SpanStore SPI over
+  hot ring + cold segments, pruning segments by zone-map before any
+  row decode.
+"""
+
+from zipkin_tpu.store.archive.directory import (  # noqa: F401
+    ArchiveParams,
+    SegmentDirectory,
+)
+from zipkin_tpu.store.archive.segment import (  # noqa: F401
+    Segment,
+    ZoneMap,
+    merge_segments,
+    seal_segment,
+)
+from zipkin_tpu.store.archive.tiered import TieredSpanStore  # noqa: F401
+
+__all__ = [
+    "ArchiveParams",
+    "Segment",
+    "SegmentDirectory",
+    "TieredSpanStore",
+    "ZoneMap",
+    "merge_segments",
+    "seal_segment",
+]

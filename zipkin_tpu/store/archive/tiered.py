@@ -1,0 +1,460 @@
+"""TieredSpanStore: the full SpanStore SPI over hot ring + cold segments.
+
+Tiering contract (what makes the federation exact):
+
+- Every span row carries a global id (gid). The hot tier is the device
+  ring: rows with gid in [write_pos - capacity, write_pos). The cold
+  tier covers gids [0, captured_upto): the capture hook in
+  TpuSpanStore pulls every row BEFORE any of the three rings (span /
+  annotation / binary) can overwrite it, so a captured copy is always
+  COMPLETE (its annotation rows were still resident at capture time)
+  and the two tiers overlap only in rows that exist identically in
+  both. Row-level reads therefore dedupe by gid, preferring the cold
+  copy (the ring twin may have lost side-table rows to the
+  faster-lapping annotation rings).
+
+- Index reads union each tier's top-``limit`` candidate list and
+  re-rank: a trace absent from BOTH per-tier top lists is outranked by
+  ``limit`` distinct traces globally (the topk_ids_with_escalation
+  argument applied across tiers), so the union is the true global
+  top-``limit``.
+
+- Cold candidates come from zone-map pruning (service bitmap, tagged
+  key CMS, ts range, trace bloom) followed by the memory-oracle match
+  functions (store/memory.py) over decoded rows — bit-for-bit the
+  reference semantics, including spans long evicted from the device.
+
+- Lifetime streaming aggregates (dependency banks, per-service
+  histograms, HLL, top-k counters) survive eviction ON DEVICE, so
+  those queries delegate to the hot store; the cold tier additionally
+  answers them from segment sketches alone (``cold_*`` methods) —
+  quantiles and cardinality without decompressing a single row.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from zipkin_tpu.columnar.encode import to_signed64
+from zipkin_tpu.models.span import Span
+from zipkin_tpu.ops.quantile import quantiles_host
+from zipkin_tpu.store.archive.directory import (
+    ArchiveParams,
+    SegmentDirectory,
+)
+from zipkin_tpu.store.archive.segment import (
+    TAG_ANN,
+    TAG_BKEY,
+    TAG_BVAL,
+    TAG_NAME,
+    seal_segment,
+)
+from zipkin_tpu.store.archive import sketches as SK
+from zipkin_tpu.store.base import (
+    IndexedTraceId,
+    SpanStore,
+    TraceIdDuration,
+    apply_pin_merges,
+    dedup_rank_limit,
+    fill_pin,
+    resolve_annotation_query,
+)
+from zipkin_tpu.store.memory import (
+    match_spans_by_annotation,
+    match_spans_by_name,
+)
+
+
+class TieredSpanStore(SpanStore):
+    """Federates a TpuSpanStore (hot) with a SegmentDirectory (cold)."""
+
+    def __init__(self, hot, params: Optional[ArchiveParams] = None,
+                 directory: Optional[SegmentDirectory] = None,
+                 registry=None, background_compaction: bool = False):
+        self.hot = hot
+        self.params = params or ArchiveParams.for_config(hot.config)
+        self.archive = directory or SegmentDirectory(
+            self.params, hot.codec, registry=registry)
+        self.captures = 0
+        hot.eviction_sink = self._capture_sink
+        if background_compaction:
+            self.archive.start_compactor()
+
+    # -- capture --------------------------------------------------------
+
+    def _capture_sink(self, batch, gids, gid_lo: int, gid_hi: int,
+                      pull_s: float) -> None:
+        """Called from the hot write path with one capture window's
+        pulled columns; seals a segment and hands it to the directory
+        (which may compact inline)."""
+        t0 = time.perf_counter()
+        spans = self.hot.codec.decode(batch)
+        seg = seal_segment(
+            self.archive.next_id(), batch, gids, spans,
+            self.hot.dicts, self.params, gid_lo, gid_hi,
+        )
+        self.archive.append(seg, cache=(batch, gids, spans))
+        self.captures += 1
+        self.archive.h_capture.observe(
+            pull_s + (time.perf_counter() - t0))
+
+    # -- writes (delegate; capture rides the hot write path) ------------
+
+    def apply(self, spans: Sequence[Span]) -> None:
+        self.hot.apply(spans)
+
+    def write_thrift(self, payload: bytes, sample_threshold: int = 0):
+        return self.hot.write_thrift(payload, sample_threshold)
+
+    def set_time_to_live(self, trace_id: int, ttl_seconds: float) -> None:
+        # Same TTL/pin bookkeeping as the hot store, but pin
+        # materialization reads THROUGH the tiers so pinning an
+        # already-evicted trace banks its cold rows too.
+        hot = self.hot
+        tid = to_signed64(trace_id)
+        with hot._lock:
+            hot.ttls[tid] = ttl_seconds
+            pin = ttl_seconds > hot.DEFAULT_TTL_S
+            if not pin:
+                hot.pins.unpin(tid)
+        if pin:
+            fill_pin(hot.pins, hot._lock, tid, lambda: (
+                self.get_spans_by_trace_ids([trace_id]) or [[]])[0])
+
+    def get_time_to_live(self, trace_id: int) -> float:
+        return self.hot.get_time_to_live(trace_id)
+
+    def capture_now(self) -> None:
+        """Flush everything resident-but-uncaptured into a segment."""
+        self.hot.capture_now()
+
+    def close(self) -> None:
+        self.archive.stop_compactor()
+        self.archive.close()
+        self.hot.eviction_sink = None
+        self.hot.close()
+
+    # -- row reads ------------------------------------------------------
+
+    def _cold_segments_for_traces(self, qids: Set[int]):
+        return self.archive.pruned_scan(
+            lambda seg: any(seg.zone.may_contain_trace(t) for t in qids)
+        )
+
+    def get_spans_by_trace_ids(self, trace_ids: Sequence[int]
+                               ) -> List[List[Span]]:
+        if not trace_ids:
+            return []
+        hot = self.hot
+        qids = {to_signed64(t) for t in trace_ids}
+        rows: Dict[int, Dict[int, Span]] = {}
+        for gid, span in hot.get_trace_rows(trace_ids):
+            rows.setdefault(to_signed64(span.trace_id), {})[gid] = span
+        t0 = time.perf_counter()
+        for seg in self._cold_segments_for_traces(qids):
+            batch, gids, spans = self.archive.decoded(seg)
+            hit = np.isin(batch.trace_id,
+                          np.fromiter(qids, np.int64, len(qids)))
+            for i in np.flatnonzero(hit):
+                span = spans[int(i)]
+                # Cold copy wins on overlap: captured before any ring
+                # could drop its annotation rows.
+                rows.setdefault(to_signed64(span.trace_id), {})[
+                    int(gids[i])] = span
+        self.archive.h_cold_query.observe(time.perf_counter() - t0)
+        by_tid = {
+            tid: [span for _, span in sorted(found.items())]
+            for tid, found in rows.items()
+        }
+        with hot._lock:
+            apply_pin_merges(hot.pins, by_tid, trace_ids, to_signed64)
+        return [
+            by_tid[to_signed64(t)] for t in trace_ids
+            if by_tid.get(to_signed64(t))
+        ]
+
+    def traces_exist(self, trace_ids: Sequence[int]) -> Set[int]:
+        if not trace_ids:
+            return set()
+        found = self.hot.traces_exist(trace_ids)
+        missing = [t for t in trace_ids if t not in found]
+        if not missing:
+            return found
+        qids = {to_signed64(t): t for t in missing}
+        t0 = time.perf_counter()
+        for seg in self._cold_segments_for_traces(set(qids)):
+            if not qids:
+                break
+            # Exact check on the trace-id column alone — one column's
+            # decompression, no row decode, no decode-cache churn.
+            tid_col = seg.column("trace_id")
+            stids = np.fromiter(qids, np.int64, len(qids))
+            for stid in stids[np.isin(stids, tid_col)]:
+                found.add(qids.pop(int(stid)))
+        self.archive.h_cold_query.observe(time.perf_counter() - t0)
+        return found
+
+    def get_traces_duration(self, trace_ids: Sequence[int]
+                            ) -> List[TraceIdDuration]:
+        if not trace_ids:
+            return []
+        bounds: Dict[int, list] = {}
+        for d in self.hot.get_traces_duration(trace_ids):
+            bounds[d.trace_id] = [d.start_timestamp,
+                                  d.start_timestamp + d.duration]
+        canon = {to_signed64(t): t for t in trace_ids}
+        t0 = time.perf_counter()
+        stids = np.fromiter(canon, np.int64, len(canon))
+        for seg in self._cold_segments_for_traces(set(canon)):
+            # Column-only read (trace id + ts bounds, no row decode)
+            # with ONE membership pass over the segment; the per-id
+            # min/max then runs on the hit rows only.
+            tid_col = seg.column("trace_id")
+            hit = np.isin(tid_col, stids)
+            if not hit.any():
+                continue
+            tid_hit = tid_col[hit]
+            tsf_hit = seg.column("ts_first")[hit]
+            tsl_hit = seg.column("ts_last")[hit]
+            for stid in np.unique(tid_hit):
+                orig = canon[int(stid)]
+                m = tid_hit == stid
+                tsf = tsf_hit[m]
+                tsl = tsl_hit[m]
+                ts = np.concatenate([tsf[tsf >= 0], tsl[tsl >= 0]])
+                if not ts.size:
+                    continue
+                b = bounds.setdefault(orig, [int(ts.min()),
+                                             int(ts.max())])
+                b[0] = min(b[0], int(ts.min()))
+                b[1] = max(b[1], int(ts.max()))
+        self.archive.h_cold_query.observe(time.perf_counter() - t0)
+        return [
+            TraceIdDuration(t, bounds[t][1] - bounds[t][0], bounds[t][0])
+            for t in trace_ids if t in bounds
+        ]
+
+    # -- index reads ----------------------------------------------------
+
+    def _cold_ids_by_name(self, service_name: str,
+                          span_name: Optional[str], end_ts: int,
+                          limit: int) -> List[IndexedTraceId]:
+        dicts = self.hot.dicts
+        svc = dicts.services.get(service_name.lower())
+        if svc is None or limit <= 0:
+            return []
+        name_lc = (dicts.span_names.get(span_name.lower())
+                   if span_name is not None else None)
+        if span_name is not None and name_lc is None:
+            return []
+
+        def probe(seg):
+            z = seg.zone
+            if svc not in z.service_ids or not z.may_match_end_ts(end_ts):
+                return False
+            if name_lc is not None and not z.may_contain_key(
+                    TAG_NAME, svc, name_lc):
+                return False
+            return True
+
+        return self._cold_match(
+            probe,
+            lambda spans: match_spans_by_name(
+                spans, service_name, span_name, end_ts),
+            limit,
+        )
+
+    def _cold_ids_by_annotation(self, service_name: str, annotation: str,
+                                value: Optional[bytes], end_ts: int,
+                                limit: int) -> List[IndexedTraceId]:
+        from zipkin_tpu.models.constants import CORE_ANNOTATIONS
+
+        dicts = self.hot.dicts
+        if annotation in CORE_ANNOTATIONS or limit <= 0:
+            return []
+        svc = dicts.services.get(service_name.lower())
+        if svc is None:
+            return []
+        resolved = resolve_annotation_query(dicts, annotation, value)
+        if resolved is None:
+            return []
+        ann_value, bann_key, bann_value, bann_value2 = resolved
+
+        def probe(seg):
+            z = seg.zone
+            if svc not in z.service_ids or not z.may_match_end_ts(end_ts):
+                return False
+            if value is not None:
+                return any(
+                    v >= 0 and z.may_contain_key(TAG_BVAL, svc,
+                                                 bann_key, v)
+                    for v in (bann_value, bann_value2)
+                )
+            may = False
+            if ann_value >= 0:
+                may = z.may_contain_key(TAG_ANN, svc, ann_value)
+            if not may and bann_key >= 0:
+                may = z.may_contain_key(TAG_BKEY, svc, bann_key)
+            return may
+
+        return self._cold_match(
+            probe,
+            lambda spans: match_spans_by_annotation(
+                spans, service_name, annotation, value, end_ts),
+            limit,
+        )
+
+    def _cold_match(self, probe, matcher, limit: int
+                    ) -> List[IndexedTraceId]:
+        t0 = time.perf_counter()
+        cands = []
+        for seg in self.archive.pruned_scan(probe):
+            _, _, spans = self.archive.decoded(seg)
+            cands.extend(
+                (s.trace_id, s.last_timestamp) for s in matcher(spans)
+                if s.last_timestamp is not None
+            )
+        self.archive.h_cold_query.observe(time.perf_counter() - t0)
+        return dedup_rank_limit(cands, limit)
+
+    @staticmethod
+    def _union(limit: int, *tiers) -> List[IndexedTraceId]:
+        """Re-rank the union of per-tier top-``limit`` lists — exact
+        (see the module docstring's cross-tier top-k argument)."""
+        return dedup_rank_limit(
+            [(i.trace_id, i.timestamp) for ids in tiers for i in ids],
+            limit,
+        )
+
+    def get_trace_ids_by_name(self, service_name: str,
+                              span_name: Optional[str], end_ts: int,
+                              limit: int) -> List[IndexedTraceId]:
+        return self._union(
+            limit,
+            self.hot.get_trace_ids_by_name(service_name, span_name,
+                                           end_ts, limit),
+            self._cold_ids_by_name(service_name, span_name, end_ts,
+                                   limit),
+        )
+
+    def get_trace_ids_by_annotation(self, service_name: str,
+                                    annotation: str,
+                                    value: Optional[bytes], end_ts: int,
+                                    limit: int) -> List[IndexedTraceId]:
+        return self._union(
+            limit,
+            self.hot.get_trace_ids_by_annotation(
+                service_name, annotation, value, end_ts, limit),
+            self._cold_ids_by_annotation(service_name, annotation,
+                                         value, end_ts, limit),
+        )
+
+    def get_trace_ids_multi(self, queries) -> List[List[IndexedTraceId]]:
+        """Hot probes ride the device's one-launch batched path; each
+        query then unions its cold candidates."""
+        hot_res = self.hot.get_trace_ids_multi(queries)
+        out = []
+        for q, hot_ids in zip(queries, hot_res):
+            if q[0] == "name":
+                _, svc, name, end_ts, limit = q
+                cold = self._cold_ids_by_name(svc, name, end_ts, limit)
+            else:
+                _, svc, ann, value, end_ts, limit = q
+                cold = self._cold_ids_by_annotation(svc, ann, value,
+                                                    end_ts, limit)
+            out.append(self._union(q[-1], hot_ids, cold))
+        return out
+
+    # -- catalogs -------------------------------------------------------
+
+    def get_all_service_names(self) -> Set[str]:
+        out = self.hot.get_all_service_names()
+        d = self.hot.dicts.services
+        for seg in self.archive.snapshot():
+            out.update(
+                name for i in seg.zone.service_ids
+                if i < len(d) and (name := d.decode(i))
+            )
+        return out
+
+    def get_span_names(self, service: str) -> Set[str]:
+        out = self.hot.get_span_names(service)
+        svc = self.hot.dicts.services.get(service.lower())
+        if svc is None:
+            return out
+        for seg in self.archive.pruned_scan(
+                lambda s: svc in s.zone.service_ids):
+            _, _, spans = self.archive.decoded(seg)
+            out.update(
+                s.name for s in match_spans_by_name(
+                    spans, service, None, (1 << 62))
+                if s.name
+            )
+        return out
+
+    # -- lifetime aggregates (device streaming state; see module doc) ---
+
+    def get_dependencies(self, start_ts: Optional[int] = None,
+                         end_ts: Optional[int] = None):
+        return self.hot.get_dependencies(start_ts, end_ts)
+
+    def archive_now(self) -> None:
+        self.hot.archive_now()
+
+    def service_duration_quantiles(self, service: str,
+                                   qs: Sequence[float]):
+        return self.hot.service_duration_quantiles(service, qs)
+
+    def top_annotations(self, service: str, k: int = 10):
+        return self.hot.top_annotations(service, k)
+
+    def top_binary_keys(self, service: str, k: int = 10):
+        return self.hot.top_binary_keys(service, k)
+
+    def estimated_unique_traces(self) -> float:
+        return self.hot.estimated_unique_traces()
+
+    def stored_span_count(self):
+        return self.hot.stored_span_count()
+
+    # -- cold-only sketch answers (no row decompression) ----------------
+
+    def cold_duration_quantiles(self, service: str, qs: Sequence[float]
+                                ) -> Optional[List[float]]:
+        """Per-service latency quantiles over CAPTURED spans, answered
+        from segment zone-map histograms alone (same ops.quantile
+        geometry as the device svc_hist)."""
+        svc = self.hot.dicts.services.get(service.lower())
+        if svc is None:
+            return None
+        counts = None
+        for seg in self.archive.snapshot():
+            row = seg.zone.dur_hist.get(svc)
+            if row is not None:
+                counts = row if counts is None else counts + row
+        if counts is None:
+            return None
+        return quantiles_host(counts, self.params.hist_gamma, 1.0,
+                              list(qs))
+
+    def cold_estimated_unique_traces(self) -> float:
+        """Distinct-trace estimate over the cold tier from merged
+        segment HLLs."""
+        regs = None
+        for seg in self.archive.snapshot():
+            regs = (seg.zone.hll if regs is None
+                    else SK.hll_merge(regs, seg.zone.hll))
+        if regs is None:
+            return 0.0
+        return SK.hll_estimate(regs)
+
+    # -- telemetry ------------------------------------------------------
+
+    def counters(self) -> Dict[str, float]:
+        out = dict(self.hot.counters())
+        out.update(self.archive.stats())
+        out["archive_captures"] = float(self.captures)
+        return out

@@ -2997,6 +2997,74 @@ def _gather_impl(
     return counts, span_mat, ann_mat, bann_mat
 
 
+@partial(jax.jit, static_argnums=(8, 9, 10, 11, 12, 13))
+def _capture_impl(
+    span_cols, ann_cols, bann_cols, lo, hi,
+    write_pos, ann_write_pos, bann_write_pos,
+    capacity: int, ann_capacity: int, bann_capacity: int,
+    k_spans: int, k_anns: int, k_banns: int,
+):
+    row_gid = span_cols[-1]
+    ann_gid = ann_cols[0]
+    bann_gid = bann_cols[0]
+    span_in = (row_gid >= lo) & (row_gid < hi)
+    ann_in = (ann_gid >= lo) & (ann_gid < hi)
+    bann_in = (bann_gid >= lo) & (bann_gid < hi)
+
+    def oldest_k(mask, wp, cap, k):
+        head = (wp % cap).astype(jnp.int32)
+        slots = jnp.arange(cap, dtype=jnp.int32)
+        age = (slots - head) % jnp.int32(cap)
+        key = jnp.where(mask, jnp.int32(cap) - age, 0)
+        _, sel = jax.lax.top_k(key, k)
+        return sel
+
+    sel = oldest_k(span_in, write_pos, capacity, k_spans)
+    span_mat = jnp.stack([c[sel].astype(jnp.int64) for c in span_cols])
+    a_sel = oldest_k(ann_in, ann_write_pos, ann_capacity, k_anns)
+    ann_mat = jnp.stack([c[a_sel].astype(jnp.int64) for c in ann_cols])
+    ann_mat = jnp.where(ann_in[a_sel][None, :], ann_mat, -1)
+    b_sel = oldest_k(bann_in, bann_write_pos, bann_capacity, k_banns)
+    bann_mat = jnp.stack([c[b_sel].astype(jnp.int64) for c in bann_cols])
+    bann_mat = jnp.where(bann_in[b_sel][None, :], bann_mat, -1)
+    counts = jnp.stack([
+        span_in.sum(dtype=jnp.int64),
+        ann_in.sum(dtype=jnp.int64),
+        bann_in.sum(dtype=jnp.int64),
+    ])
+    return counts, span_mat, ann_mat, bann_mat
+
+
+def capture_eviction_rows(
+    state: StoreState, lo: int, hi: int,
+    k_spans: int, k_anns: int, k_banns: int,
+):
+    """Eviction capture: pull every ring row (span + annotation +
+    binary) whose SPAN gid falls in [lo, hi), compacted to the front in
+    insertion order — the cold tier's batched host pull. Same stacked
+    matrix shape as gather_trace_rows so the host decode path is
+    shared. A PURE READ: the fused ingest step's lowering is untouched
+    (bench_smoke's 95/5/79 census gate holds with capture wired); the
+    cold tier pays one extra read-only launch + one D2H per capture
+    window on the existing archive cadence.
+
+    The caller triggers the pull BEFORE any of the three rings can
+    overwrite a row in the window (TpuSpanStore._maybe_capture tracks
+    all three write cursors), so every captured span is complete —
+    including side-table rows a faster-lapping annotation ring would
+    have dropped first."""
+    c = state.config
+    return _capture_impl(
+        tuple(getattr(state, col) for col in SPAN_MAT_COLS),
+        tuple(getattr(state, col) for col in ANN_MAT_COLS),
+        tuple(getattr(state, col) for col in BANN_MAT_COLS),
+        jnp.int64(lo), jnp.int64(hi),
+        state.write_pos, state.ann_write_pos, state.bann_write_pos,
+        c.capacity, c.ann_capacity, c.bann_capacity,
+        k_spans, k_anns, k_banns,
+    )
+
+
 def gather_trace_rows(
     state: StoreState, sorted_qids, k_spans: int, k_anns: int, k_banns: int,
 ):

@@ -36,6 +36,55 @@ from zipkin_tpu.store.base import (
 )
 
 
+def match_spans_by_name(spans, service_name: str,
+                        span_name: Optional[str], end_ts: int
+                        ) -> List[Span]:
+    """The reference store's name-index match over a plain span list —
+    module-level so the cold-tier segment scan
+    (store/archive/tiered.py) applies EXACTLY the oracle's semantics to
+    decoded segment rows (one definition, zero drift)."""
+    name = service_name.lower()
+    matched = [
+        s for s in spans if should_index(s) and name in s.service_names
+    ]
+    if span_name is not None:
+        wanted = span_name.lower()
+        matched = [s for s in matched if s.name.lower() == wanted]
+    return [
+        s for s in matched
+        if s.last_timestamp is not None and s.last_timestamp <= end_ts
+    ]
+
+
+def match_spans_by_annotation(spans, service_name: str, annotation: str,
+                              value: Optional[bytes], end_ts: int
+                              ) -> List[Span]:
+    """Annotation-index match over a plain span list (see
+    match_spans_by_name for why this is module-level)."""
+    if annotation in CORE_ANNOTATIONS:
+        return []
+    name = service_name.lower()
+    candidates = [
+        s for s in spans if should_index(s) and name in s.service_names
+    ]
+    matched = []
+    for s in candidates:
+        if s.last_timestamp is None or s.last_timestamp > end_ts:
+            continue
+        if value is not None:
+            ok = any(
+                b.key == annotation and as_bytes(b.value) == value
+                for b in s.binary_annotations
+            )
+        else:
+            ok = any(a.value == annotation for a in s.annotations) or any(
+                b.key == annotation for b in s.binary_annotations
+            )
+        if ok:
+            matched.append(s)
+    return matched
+
+
 class InMemorySpanStore(SpanStore):
     def __init__(self):
         self._lock = threading.Lock()
@@ -89,16 +138,12 @@ class InMemorySpanStore(SpanStore):
         end_ts: int,
         limit: int,
     ) -> List[IndexedTraceId]:
-        matched = self._spans_for_service(service_name)
-        if span_name is not None:
-            wanted = span_name.lower()
-            matched = [s for s in matched if s.name.lower() == wanted]
-        matched = [
-            s
-            for s in matched
-            if s.last_timestamp is not None and s.last_timestamp <= end_ts
-        ]
-        return _dedup_limit(matched, limit)
+        with self._lock:
+            snapshot = list(self.spans)
+        return _dedup_limit(
+            match_spans_by_name(snapshot, service_name, span_name, end_ts),
+            limit,
+        )
 
     def get_trace_ids_by_annotation(
         self,
@@ -109,25 +154,14 @@ class InMemorySpanStore(SpanStore):
         limit: int,
     ) -> List[IndexedTraceId]:
         # Core annotations are not indexed (SpanStore.scala:199).
-        if annotation in CORE_ANNOTATIONS:
-            return []
-        candidates = self._spans_for_service(service_name)
-        matched = []
-        for s in candidates:
-            if s.last_timestamp is None or s.last_timestamp > end_ts:
-                continue
-            if value is not None:
-                ok = any(
-                    b.key == annotation and as_bytes(b.value) == value
-                    for b in s.binary_annotations
-                )
-            else:
-                ok = any(a.value == annotation for a in s.annotations) or any(
-                    b.key == annotation for b in s.binary_annotations
-                )
-            if ok:
-                matched.append(s)
-        return _dedup_limit(matched, limit)
+        with self._lock:
+            snapshot = list(self.spans)
+        return _dedup_limit(
+            match_spans_by_annotation(
+                snapshot, service_name, annotation, value, end_ts
+            ),
+            limit,
+        )
 
     def get_traces_duration(self, trace_ids: Sequence[int]) -> List[TraceIdDuration]:
         with self._lock:

@@ -231,20 +231,20 @@ def name_lc_ids(batch: SpanBatch, dicts: DictionarySet,
     return out
 
 
-def decode_gathered(
-    codec: SpanCodec, n_s: int, n_a: int, n_b: int,
+def mats_to_batch(
+    n_s: int, n_a: int, n_b: int,
     span_mat: np.ndarray, ann_mat: np.ndarray, bann_mat: np.ndarray,
-) -> List[Span]:
-    """Decode the stacked i64 matrices dev.gather_trace_rows produced
-    (already compacted, spans in insertion order) into Span objects.
-    Shared by the single-store and sharded read paths."""
-    if n_s == 0:
-        return []
+) -> Tuple[SpanBatch, np.ndarray]:
+    """(SpanBatch, per-row gids) from the stacked i64 matrices the
+    gather/capture kernels produce (already compacted, spans in
+    insertion order). Shared by the query decode paths and the
+    cold-tier eviction capture (which seals the batch into a segment
+    instead of decoding spans)."""
     batch = SpanBatch.empty(n_s, n_a, n_b)
     for i, col in enumerate(dev.SPAN_MAT_COLS[:-1]):  # row_gid is last
         tgt = getattr(batch, col)
         setattr(batch, col, span_mat[i, :n_s].astype(tgt.dtype))
-    gids = span_mat[len(dev.SPAN_MAT_COLS) - 1, :n_s]
+    gids = span_mat[len(dev.SPAN_MAT_COLS) - 1, :n_s].astype(np.int64)
     gid_to_local = {int(g): i for i, g in enumerate(gids)}
     if n_a:
         a = {name: ann_mat[i, :n_a]
@@ -267,6 +267,19 @@ def decode_gathered(
         batch.bann_type = b["bann_type"].astype(np.uint8)
         batch.bann_service_id = b["bann_service_id"].astype(np.int32)
         batch.bann_endpoint_id = b["bann_endpoint_id"].astype(np.int32)
+    return batch, gids
+
+
+def decode_gathered(
+    codec: SpanCodec, n_s: int, n_a: int, n_b: int,
+    span_mat: np.ndarray, ann_mat: np.ndarray, bann_mat: np.ndarray,
+) -> List[Span]:
+    """Decode the stacked i64 matrices dev.gather_trace_rows produced
+    into Span objects. Shared by the single-store and sharded read
+    paths."""
+    if n_s == 0:
+        return []
+    batch, _ = mats_to_batch(n_s, n_a, n_b, span_mat, ann_mat, bann_mat)
     return codec.decode(batch)
 
 
@@ -298,6 +311,21 @@ class TpuSpanStore(SpanStore):
         # the dependency bucket rotation without a device sync per batch.
         self._wp = 0
         self._archived = 0
+        # Eviction capture (cold tier, store/archive): when a sink is
+        # attached, the write path pulls every ring row to the host
+        # BEFORE any of the three rings can overwrite it. The mirrors
+        # track each ring's write cursor (host-side, no device sync)
+        # and the per-ring capture high-water marks; a capture window
+        # is always [_cap_upto, _wp) with EXACTLY _awp - _cap_a
+        # annotation rows (each batch's side rows belong to its own
+        # spans), so the pull needs no count escalation in steady
+        # state. sink(batch, gids, gid_lo, gid_hi, pull_seconds).
+        self.eviction_sink = None
+        self._awp = 0
+        self._bwp = 0
+        self._cap_upto = 0
+        self._cap_a = 0
+        self._cap_b = 0
         # Pending-sweep pacing: sweep every SWEEP_EVERY batches on the
         # write path (bounds how long a cross-batch child waits for its
         # link) and lazily before dependency reads — but only when
@@ -595,10 +623,16 @@ class TpuSpanStore(SpanStore):
         groups of equal-padded chunks into single ``dev.ingest_steps``
         launches — one ~100ms dispatch per GROUP instead of per chunk
         (NOTES_r03 §3 cost model; the ItemQueue batch-drain role,
-        ItemQueue.scala:39). Groups are bounded by capacity//2 spans so
-        the archive cadence (one dependency-bucket close per half ring)
-        can never be outrun inside one launch."""
+        ItemQueue.scala:39). Spans are bounded by capacity//2 so the
+        archive cadence (one dependency-bucket close per half ring) can
+        never be outrun inside one launch; annotation/binary rows are
+        bounded by their FULL ring capacities — a group exceeding one
+        would overwrite its own side rows mid-launch, where no capture
+        hook can run (the pre-launch capture trigger already protects
+        every OLDER uncaptured row up to exactly this bound)."""
         span_budget = max(1, self.config.capacity // 2)
+        ann_budget = max(1, self.config.ann_capacity)
+        bann_budget = max(1, self.config.bann_capacity)
         i = 0
         n = len(parts)
         while i < n:
@@ -607,7 +641,11 @@ class TpuSpanStore(SpanStore):
                 if i + size > n:
                     continue
                 group = parts[i:i + size]
-                if sum(p[0].n_spans for p in group) <= span_budget:
+                if (sum(p[0].n_spans for p in group) <= span_budget
+                        and sum(p[0].n_annotations for p in group)
+                        <= ann_budget
+                        and sum(p[0].n_binary for p in group)
+                        <= bann_budget):
                     self._write_device_many(group)
                     took = size
                     break
@@ -637,10 +675,15 @@ class TpuSpanStore(SpanStore):
         ]
         stacked = dev.stack_device_batches(dbs)
         total = sum(b.n_spans for b, _, _ in group)
+        total_a = sum(b.n_annotations for b, _, _ in group)
+        total_b = sum(b.n_binary for b, _, _ in group)
+        self._maybe_capture(total, total_a, total_b)
         self._maybe_archive(total)
         with self._rw.write():
             self.state = dev.ingest_steps(self.state, stacked)
         self._wp += total
+        self._awp += total_a
+        self._bwp += total_b
         self._step_seq += 1
         self._observe_ingest(_time.perf_counter() - t0)
         self._batches_since_sweep += len(group)
@@ -663,10 +706,14 @@ class TpuSpanStore(SpanStore):
             pad_anns=_next_pow2(batch.n_annotations),
             pad_banns=_next_pow2(batch.n_binary),
         )
+        self._maybe_capture(batch.n_spans, batch.n_annotations,
+                            batch.n_binary)
         self._maybe_archive(batch.n_spans)
         with self._rw.write():
             self.state = dev.ingest_step(self.state, db)
         self._wp += batch.n_spans
+        self._awp += batch.n_annotations
+        self._bwp += batch.n_binary
         self._step_seq += 1
         self._observe_ingest(_time.perf_counter() - t0)
         self._batches_since_sweep += 1
@@ -708,6 +755,95 @@ class TpuSpanStore(SpanStore):
             self._wp, max(self._wp + incoming - cap, self._wp - cap // 2)
         )
 
+    def _maybe_capture(self, n_s: int, n_a: int, n_b: int) -> None:
+        """Eviction capture trigger, called BEFORE every device write
+        with the incoming row counts: if the write would overwrite any
+        uncaptured row in ANY of the three rings (the annotation rings
+        lap faster than the span ring whenever spans average more side
+        rows than the capacity ratio), pull the whole uncaptured window
+        [_cap_upto, _wp) to the host and hand it to the sink. Riding
+        the write path keeps the invariant simple — every captured row
+        is still fully resident — and adds ZERO ops to the fused ingest
+        step (the pull is its own read-only launch)."""
+        sink = self.eviction_sink
+        if sink is None:
+            return
+        c = self.config
+        if (self._wp + n_s - self._cap_upto <= c.capacity
+                and self._awp + n_a - self._cap_a <= c.ann_capacity
+                and self._bwp + n_b - self._cap_b <= c.bann_capacity):
+            return
+        self._capture_window()
+
+    def _capture_window(self) -> None:
+        """Pull + seal the whole uncaptured window [cap_upto, wp) —
+        the ONE capture body behind the write-path trigger and
+        capture_now. Runs under the writer lock: apply/write_thrift
+        hold self._lock around their whole write path (and direct
+        write_batch callers must serialize like any writer — two
+        concurrent writers already violate the ring-scatter uniqueness
+        contract), so clock reads, the pull, the sink append, and the
+        clock advance are atomic against every other writer AND against
+        checkpoint.save's manifest cut (which snapshots under the same
+        lock)."""
+        lo, hi = self._cap_upto, self._wp
+        cap_anns = self._awp - self._cap_a
+        cap_banns = self._bwp - self._cap_b
+        if hi <= lo:
+            self._cap_upto, self._cap_a, self._cap_b = (
+                self._wp, self._awp, self._bwp)
+            return
+        import time as _time
+
+        t0 = _time.perf_counter()
+        batch, gids = self._pull_evicted_rows(lo, hi, cap_anns,
+                                              cap_banns)
+        self.eviction_sink(batch, gids, lo, hi,
+                           _time.perf_counter() - t0)
+        # Clocks advance only AFTER the pull and seal succeed: a
+        # transient device error mid-capture leaves the window
+        # uncaptured-but-resident, and the next write retries it —
+        # stamping first would silently skip it forever.
+        self._cap_upto, self._cap_a, self._cap_b = (
+            self._wp, self._awp, self._bwp)
+
+    def capture_now(self) -> None:
+        """Flush the uncaptured window [cap_upto, write_pos) to the
+        eviction sink immediately — checkpoint restore uses this to
+        re-align the capture clocks (the ann/bann mirrors don't survive
+        a restart), and operators can call it to make the cold tier
+        current before a planned shutdown."""
+        with self._lock:
+            if self.eviction_sink is None:
+                return
+            self._capture_window()
+
+    def _pull_evicted_rows(self, lo: int, hi: int, n_anns: int,
+                           n_banns: int):
+        """One capture window as (SpanBatch, gids): a single
+        dev.capture_eviction_rows launch + D2H. The host mirrors
+        predict the side-row counts exactly; the escalation loop is a
+        belt-and-braces guard, not the steady state."""
+        from zipkin_tpu.store.base import escalate_cap
+
+        c = self.config
+        k_s = min(_next_pow2(hi - lo), c.capacity)
+        k_a = min(_next_pow2(max(n_anns, 1)), c.ann_capacity)
+        k_b = min(_next_pow2(max(n_banns, 1)), c.bann_capacity)
+        while True:
+            with self._rw.read():
+                counts, s_m, a_m, b_m = jax.device_get(
+                    dev.capture_eviction_rows(self.state, lo, hi,
+                                              k_s, k_a, k_b)
+                )
+            n_s, n_a, n_b = (int(x) for x in counts)
+            if n_s <= k_s and n_a <= k_a and n_b <= k_b:
+                break
+            k_s = escalate_cap(n_s, k_s, c.capacity)
+            k_a = escalate_cap(n_a, k_a, c.ann_capacity)
+            k_b = escalate_cap(n_b, k_b, c.bann_capacity)
+        return mats_to_batch(n_s, n_a, n_b, s_m, a_m, b_m)
+
     def adopt_state(self, state, spans_written: int,
                     archived: Optional[int] = None) -> None:
         """Adopt a device state produced OUTSIDE the store's write path
@@ -730,6 +866,11 @@ class TpuSpanStore(SpanStore):
         self._wp = int(spans_written)
         self._archived = self._wp if archived is None else int(archived)
         self._batches_since_sweep = 1
+        # The adopted state's history predates the sink: re-seed the
+        # capture clocks so only post-adoption evictions are captured.
+        self._awp = self._bwp = 0
+        self._cap_upto = self._wp
+        self._cap_a = self._cap_b = 0
 
     # TTLs above the per-write default mark a trace pinned: its spans are
     # materialized to the host pin bank so ring eviction can't drop them.
@@ -942,11 +1083,10 @@ class TpuSpanStore(SpanStore):
         return exist_from_duration_mat(canon, qids, mat[0], self.pins,
                                        self._lock)
 
-    def get_spans_by_trace_ids(self, trace_ids: Sequence[int],
-                               force_scan: bool = False
-                               ) -> List[List[Span]]:
-        if not trace_ids:
-            return []
+    def _gather_trace_mats(self, trace_ids: Sequence[int],
+                           force_scan: bool = False):
+        """Shared ring gather for whole-trace reads: (n_s, n_a, n_b,
+        span_mat, ann_mat, bann_mat)."""
         qids = self._sorted_qids(trace_ids)
         with self._rw.read():
             st = self.state
@@ -962,7 +1102,35 @@ class TpuSpanStore(SpanStore):
                     return n_s, n_a, n_b, (n_s, n_a, n_b, s_m, a_m, b_m)
 
                 payload = gather_with_escalation(self.config, fetch)
-            n_s, n_a, n_b, span_mat, ann_mat, bann_mat = payload
+        return payload
+
+    def get_trace_rows(self, trace_ids: Sequence[int],
+                       force_scan: bool = False
+                       ) -> List[Tuple[int, Span]]:
+        """Ring rows of the requested traces as (row gid, Span) pairs
+        in insertion order, WITHOUT pin-bank merging — the hot-tier
+        read the TieredSpanStore dedupes against cold segments by gid
+        (a row captured before eviction exists identically in both
+        tiers while it stays resident)."""
+        if not trace_ids:
+            return []
+        n_s, n_a, n_b, span_mat, ann_mat, bann_mat = (
+            self._gather_trace_mats(trace_ids, force_scan))
+        if n_s == 0:
+            return []
+        batch, gids = mats_to_batch(
+            n_s, n_a, n_b, span_mat, ann_mat, bann_mat)
+        return [
+            (int(g), s) for g, s in zip(gids, self.codec.decode(batch))
+        ]
+
+    def get_spans_by_trace_ids(self, trace_ids: Sequence[int],
+                               force_scan: bool = False
+                               ) -> List[List[Span]]:
+        if not trace_ids:
+            return []
+        n_s, n_a, n_b, span_mat, ann_mat, bann_mat = (
+            self._gather_trace_mats(trace_ids, force_scan))
         spans = self._decode_gathered(
             n_s, n_a, n_b, span_mat, ann_mat, bann_mat
         )
