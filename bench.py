@@ -766,6 +766,129 @@ def bench_archive(total_spans: int = 100_000):
     }
 
 
+def bench_pipeline(total_spans: int = 100_000, depth: int = 8,
+                   capture_backlog: int = 64):
+    """Pipelined-ingest phase (r9 tentpole): the same span stream
+    driven through the serial write path (inline capture sealing) and
+    through the three-stage pipeline (encode ∥ H2D staging ∥ device
+    compute, async eviction sealer). On real hardware the interesting
+    numbers are the spans/s delta (how much host encode + staging +
+    capture sealing the pipeline hides behind device compute) and the
+    overlap efficiency (stage-busy seconds / wall, > 1 means true
+    overlap); equality of the device counter blocks plus a sample
+    query double-checks identity cheaply (the bitwise-leaf proof runs
+    on the CPU mesh every CI run — tests/test_pipeline.py)."""
+    import numpy as np  # noqa: F401
+
+    import jax
+
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.archive import ArchiveParams, TieredSpanStore
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    cap = 1 << max(9, (total_spans // 4).bit_length() - 1)
+    config = dev.StoreConfig(
+        capacity=cap, ann_capacity=4 * cap, bann_capacity=2 * cap,
+        max_services=64, max_span_names=256,
+        max_annotation_values=512, max_binary_keys=64,
+        cms_width=1 << 12, hll_p=10, quantile_buckets=512,
+    )
+    _log(f"pipeline phase: ring 2^{cap.bit_length() - 1}, "
+         f"{total_spans} spans, depth {depth}")
+    spans = []
+    while len(spans) < total_spans:
+        spans.extend(
+            s for t in generate_traces(
+                n_traces=max(total_spans // 5, 64), max_depth=3,
+                n_services=32,
+            ) for s in t
+        )
+    spans = spans[:total_spans]
+    chunk = 1024
+
+    def build(backlog):
+        hot = TpuSpanStore(config)
+        hot.capture_backlog = backlog
+        return hot, TieredSpanStore(
+            hot, params=ArchiveParams.for_config(config))
+
+    def stream(store):
+        t0 = time.perf_counter()
+        for i in range(0, len(spans), chunk):
+            store.apply(spans[i:i + chunk])
+        drain = getattr(store, "drain_pipeline", None)
+        if drain is not None:
+            drain()
+            store.seal_barrier()
+        return time.perf_counter() - t0
+
+    # Warm BOTH modes' jit cache rows (staged device args key their
+    # own entries — dev.stage_batch).
+    _, warm_t = build(0)
+    stream(warm_t)
+    wh, wt = build(capture_backlog)
+    wh.start_pipeline(depth)
+    stream(wt)
+    wt.close()
+
+    sh, st = build(0)
+    serial_s = stream(st)
+    ph, pt = build(capture_backlog)
+    compiles0 = dev.compile_count()
+    pipe = ph.start_pipeline(depth)
+    pipelined_s = stream(pt)
+    recompiles = dev.compile_count() - compiles0
+    encode_s, stage_s, commit_s = (
+        pipe.h_encode.sum, pipe.h_stage.sum, pipe.h_commit.sum)
+    stall_s = float(pipe.c_stall.value)
+    ph.stop_pipeline()
+    sealer = ph._sealer
+    cb_serial = {k: v for k, v in sh.counter_block().items()}
+    cb_piped = {k: v for k, v in ph.counter_block().items()}
+    svc = sorted(pt.get_all_service_names())[0]
+    end_ts = int(jax.device_get(ph.state.ts_max)) + 1
+    same_query = (
+        pt.get_trace_ids_by_name(svc, None, end_ts, 50)
+        == st.get_trace_ids_by_name(svc, None, end_ts, 50)
+    )
+    out = {
+        "spans": len(spans),
+        "depth": depth,
+        "capture_backlog": capture_backlog,
+        "serial_spans_per_s": round(len(spans) / serial_s, 1),
+        "pipelined_spans_per_s": round(len(spans) / pipelined_s, 1),
+        "speedup": round(serial_s / pipelined_s, 3),
+        "overlap_efficiency": round(
+            (encode_s + stage_s + commit_s) / pipelined_s, 2),
+        "encode_s": round(encode_s, 3),
+        "stage_s": round(stage_s, 3),
+        "commit_s": round(commit_s, 3),
+        "prefetch_stall_s": round(stall_s, 3),
+        "capture_stall_s": round(
+            float(sealer.c_stall.value) if sealer else 0.0, 3),
+        "windows_sealed": int(sealer.c_sealed.value) if sealer else 0,
+        "recompiles_after_warmup": int(recompiles),
+        "counter_blocks_identical": cb_serial == cb_piped,
+        "sample_query_identical": bool(same_query),
+        "ingest_dispatch_ms": _sketch_ms(ph._h_dispatch),
+        "ingest_true_step_ms": _sketch_ms(ph._h_ingest),
+    }
+    st.close()
+    pt.close()
+    return out
+
+
+def _sketch_ms(sketch) -> dict:
+    """Latency sketch snapshot with the time keys scaled to ms."""
+    return {
+        k: (round(v * 1e3, 3)
+            if k in ("sum", "mean", "stddev", "p50", "p99") and v == v
+            else v)
+        for k, v in sketch.snapshot().items()
+    }
+
+
 def bench_checkpoint(store):
     """Checkpoint at bench scale (VERDICT r3 item 8): snapshot the
     streamed store, restore it, and require bit-identical answers to a
@@ -967,6 +1090,9 @@ def main():
                     help="traces per template batch in the full config "
                          "(x7 spans; larger batches shrink the per-scan-"
                          "iteration floor share — tune on real hardware)")
+    ap.add_argument("--pipeline-depth", type=int, default=8,
+                    help="prefetch depth for the pipelined-ingest "
+                         "phase (bounded stage-1 queue)")
     ap.add_argument("--exactness-budget", type=float, default=120.0,
                     help="wall-clock budget (s) for the index-vs-scan "
                          "exactness phase in full runs (each force_scan "
@@ -1050,6 +1176,16 @@ def main():
                 int(2e4) if args.smoke else int(4e5)),
             timeout_s=900, label="archive")
         emit("stream+queries+exactness+archive")
+        # Pipelined ingest (r9 tentpole): serial vs three-stage
+        # pipelined drive of the same stream, capture sealing async.
+        # Bounded like the archive phase — a failure here must not
+        # strand the already-emitted core phases.
+        detail["pipelined_ingest"] = _bounded(
+            lambda: bench_pipeline(
+                int(2e4) if args.smoke else int(4e5),
+                depth=args.pipeline_depth),
+            timeout_s=900, label="pipeline")
+        emit("stream+queries+exactness+archive+pipeline")
         # The XLA-vs-pallas kernel decision was measured and recorded in
         # round 4 (xla 158.6k vs pallas 155.0k spans/s, NOTES_r04 §3);
         # re-measuring it on every full run cost two extra compile+

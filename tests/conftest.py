@@ -102,6 +102,14 @@ _SLOW_TESTS = {
     "test_annotation_heavy_chained_writes_stay_complete",
     "test_transient_pull_failure_is_retried_not_skipped",
     "test_query_client_methods",
+    # Pipelined-ingest stress lane (tests/test_pipeline.py): the fast
+    # lane keeps the bitwise pipelined==serial gate, the zero-recompile
+    # gate, lifecycle/error surfacing, and the metric split; these
+    # three re-drive tiered stores / sleep on a slow sealer / run a
+    # threaded save, which the fast-lane wall budget can't afford.
+    "test_pipelined_capture_matches_inline_sealing",
+    "test_capture_backpressure_bounds_memory",
+    "test_checkpoint_during_pipelined_ingest",
 }
 
 

@@ -73,3 +73,20 @@ def test_bench_smoke_json_and_op_ceilings():
     # equality is the invariant here.)
     assert (ar["step_census_with_capture"]
             == ar["step_census_plain"]), ar
+    # Pipelined-ingest phase (r9 tentpole): the three-stage pipeline
+    # must land a bitwise-identical device state AND an identical cold
+    # tier, a warmed steady state must perform ZERO jit recompiles
+    # (pow2 staging buckets only hit cached entries), H2D staging must
+    # add zero ops to the fused step's lowering (its census with
+    # device-resident args equals the host-array census — the
+    # step_scatters/sorts/gathers ceilings above were already measured
+    # with the obs layer wired), and ingest must never have stalled on
+    # capture sealing at the phase's generous backlog (deliberate
+    # backpressure is exercised in tests/test_pipeline.py).
+    pp = rec["pipeline"]
+    assert pp["identical"] is True, pp
+    assert pp["recompiles_after_warmup"] == 0, pp
+    assert pp["staging_census_equal"] is True, pp
+    assert pp["capture_stall_s"] == 0, pp
+    assert pp["windows_sealed"] >= 1, pp
+    assert pp["pipelined_ingest_s"] > 0 and pp["serial_ingest_s"] > 0

@@ -221,6 +221,15 @@ def _state_generation(store, n_shards, deadline_s) -> list:
     )
 
 
+def _seal_barrier(store) -> None:
+    """Wait for the store's async capture sealer (if any) to finish
+    every pulled window — see the call sites in save() for why this
+    must run under the state read lock."""
+    barrier = getattr(store, "seal_barrier", None)
+    if barrier is not None:
+        barrier()
+
+
 def save(store, path: str, chunk_deadline_s: Optional[float] = None,
          slab_retries: int = 1) -> dict:
     """Snapshot a TpuSpanStore OR a ShardedSpanStore to ``path`` (a
@@ -252,6 +261,13 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
     ensure = getattr(store, "ensure_writable", None)
     if ensure is not None and getattr(store, "suspect", False):
         ensure(wait_s=5.0)
+    # Pipelined-ingest quiesce: batches accepted by apply() but still
+    # in the prefetch/staging queues must land in this cut, or a
+    # restore would silently drop them (the collector already counted
+    # them stored). No-op for serial stores and shard stores.
+    drain = getattr(store, "drain_pipeline", None)
+    if drain is not None:
+        drain()
     stats: dict = {"resumed_leaves": 0, "chunked": chunk_deadline_s
                    is not None}
     staging = os.path.abspath(path) + ".staging"
@@ -264,6 +280,12 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
         # resumability. Ingest donates the previous state's buffers, so
         # the lock must cover the gather.
         with store._rw.read():
+            # Capture-backlog quiesce, UNDER the read lock: any window
+            # pulled before this point seals now; a window pulled
+            # after cannot lose rows from this cut (its overwriting
+            # write blocks on the write lock until the gather is done,
+            # so the rows are still resident in the gathered state).
+            _seal_barrier(store)
             state = store.states if n_shards else store.state
             host_state = jax.device_get(state)
         for name in dev.StoreState._FIELDS:
@@ -283,6 +305,7 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
         # nothing relies on callers reading a docstring anymore.
         try:
             with store._rw.read():
+                _seal_barrier(store)  # same argument as the fast path
                 gen = _state_generation(store, n_shards,
                                         chunk_deadline_s)
                 if os.path.isdir(staging):
@@ -342,18 +365,31 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
         }
         ttls_snapshot = {str(k): v for k, v in store.ttls.items()}
         if tiered is not None:
-            # Under the hot store's writer lock: apply/write_thrift
-            # hold it across their whole write path (captures
-            # included), and direct write_batch callers must serialize
-            # like any writer, so the (captured watermark, segment
-            # list) pair is an atomic cut. The manifest may cover gids
-            # past the device cut (a capture can land between the
-            # state gather and here) — a harmless superset, never a
-            # loss.
+            # The manifest cuts at the SEALED frontier, not the pull
+            # clock: with an async sealer, _cap_upto can run ahead of
+            # the last appended segment, and claiming an unsealed
+            # window would lose it on restore (restore re-captures
+            # only [captured_upto, wp) from the restored rings).
+            # Inline sealing keeps the two equal. ORDER MATTERS: the
+            # clock reads come BEFORE the segment snapshot — segments
+            # only grow, so every window sealed before the clock read
+            # has its segment in the (later) snapshot; a pipelined
+            # store's commit thread doesn't hold store._lock, and the
+            # reverse order could claim a window sealed between the
+            # two reads without shipping its segment. The segment
+            # list may then cover gids PAST captured_upto — a harmless
+            # superset (gid dedup), never a loss. Windows pulled after
+            # save's under-lock seal barrier can't lose rows from this
+            # cut either way: their overwriting writes blocked on the
+            # write lock until the state gather finished, so the rows
+            # are resident in the gathered ring state.
+            captured_upto = int(min(
+                store._cap_upto,
+                getattr(store, "_sealed_upto", store._cap_upto)))
             segs = tiered.archive.snapshot()
             archive_meta = {
                 "params": tiered.params._asdict(),
-                "captured_upto": int(store._cap_upto),
+                "captured_upto": captured_upto,
                 "segments": [
                     {"seg_id": s.seg_id, "gid_lo": s.gid_lo,
                      "gid_hi": s.gid_hi, "n_spans": s.n_spans,
@@ -771,7 +807,20 @@ def _restore_tiered(path: str, store, arch: dict):
     directory.restore(
         segs, max((s.seg_id for s in segs), default=-1) + 1)
     tiered = TieredSpanStore(store, params=params, directory=directory)
-    store._cap_upto = min(int(arch.get("captured_upto", 0)), store._wp)
+    # The save-time manifest may ship a segment sealed just past its
+    # captured_upto clock read (harmless superset, see save()); adopt
+    # the segments' CONTIGUOUS frontier so the capture_now flush below
+    # starts exactly where sealed coverage ends — keeping cold
+    # coverage contiguous and overlap-free. Walking contiguity (not
+    # max(gid_hi)) matters when a failed async seal left a hole: the
+    # frontier must stop below the hole so the flush re-captures
+    # whatever of it the restored rings still hold.
+    frontier = int(arch.get("captured_upto", 0))
+    for s in sorted(segs, key=lambda s: s.gid_lo):
+        if s.gid_lo <= frontier:
+            frontier = max(frontier, s.gid_hi)
+    store._cap_upto = min(frontier, store._wp)
+    store._sealed_upto = store._cap_upto
     store._awp = store._bwp = 0
     store._cap_a = store._cap_b = 0
     tiered.capture_now()

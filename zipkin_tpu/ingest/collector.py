@@ -59,8 +59,22 @@ class Collector:
         registry: Optional[obs.Registry] = None,
         self_trace: bool = False,
         self_service_name: str = "zipkin-tpu",
+        pipeline_depth: int = 0,
     ):
         self.store = store
+        # Pipelined ingest (store/pipeline): queue workers become the
+        # pipeline's stage-1 producers (encode + pad outside the device
+        # critical section) and the store's commit thread feeds the
+        # accelerator. flush()/close() drain it so "flushed" keeps
+        # meaning "visible to reads".
+        if pipeline_depth:
+            start = getattr(store, "start_pipeline", None)
+            if start is None:
+                raise ValueError(
+                    "pipeline_depth requires a store with pipelined "
+                    "ingest (TpuSpanStore / TieredSpanStore)"
+                )
+            start(pipeline_depth)
         self.sampler = sampler or Sampler(1.0)
         reg = registry or obs.default_registry()
         self.queue: ItemQueue = ItemQueue(
@@ -303,11 +317,19 @@ class Collector:
             self.sampler.rate = new_rate
         return new_rate
 
+    def _drain_store_pipeline(self) -> None:
+        drain = getattr(self.store, "drain_pipeline", None)
+        if drain is not None:
+            drain()
+
     def flush(self) -> None:
         self.queue.join()
         self._flush_self_spans()
+        self._drain_store_pipeline()
 
     def close(self) -> None:
         self.queue.close()
         self._flush_self_spans()
+        # store.close() stops the ingest pipeline (draining accepted
+        # batches) and the capture sealer before returning.
         self.store.close()

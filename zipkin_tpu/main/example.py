@@ -47,6 +47,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "segment archive and federate queries across "
                         "hot + cold (store/archive; single-device "
                         "stores only)")
+    p.add_argument("--pipeline-depth", type=int, default=0,
+                   help="pipelined ingest: overlap host encode + H2D "
+                        "staging with device compute behind a bounded "
+                        "prefetch queue of this depth (0 = serial "
+                        "write path; single-device stores only — see "
+                        "docs/INGEST_PIPELINE.md)")
+    p.add_argument("--capture-backlog", type=int, default=4,
+                   help="cold-tier async sealer: bound on pulled-but-"
+                        "unsealed eviction capture windows; a full "
+                        "backlog is the only way capture can stall "
+                        "ingest (0 = seal inline on the write path)")
     p.add_argument("--seed-traces", type=int, default=0,
                    help="generate N synthetic traces at startup")
     p.add_argument("--checkpoint", default=None,
@@ -132,6 +143,11 @@ def build_app(args):
             from zipkin_tpu.store.archive import TieredSpanStore
 
             store = TieredSpanStore(store, background_compaction=True)
+    # The async capture sealer takes effect the first time a capture
+    # window is pulled, so the knob just needs to be set before writes.
+    hot = getattr(store, "hot", store)
+    if hasattr(hot, "capture_backlog"):
+        hot.capture_backlog = max(0, args.capture_backlog)
     adaptive = (
         AdaptiveConfig(target_store_rate=args.adaptive_target)
         if args.adaptive_target > 0 else None
@@ -140,6 +156,7 @@ def build_app(args):
         store, sampler=Sampler(args.sample_rate), adaptive=adaptive,
         max_queue=args.queue_max, concurrency=args.queue_workers,
         self_trace=not args.no_self_trace_ingest,
+        pipeline_depth=args.pipeline_depth,
     )
     api = ApiServer(QueryService(store), collector)
     return store, collector, api

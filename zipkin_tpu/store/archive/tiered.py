@@ -132,15 +132,48 @@ class TieredSpanStore(SpanStore):
         self.hot.capture_now()
 
     def close(self) -> None:
+        # Hot store first: it drains the ingest pipeline (committing
+        # accepted batches, which may trigger final captures) and then
+        # the capture sealer — only after that is detaching the sink
+        # safe (a pending async seal still needs it).
+        self.hot.close()
         self.archive.stop_compactor()
         self.archive.close()
         self.hot.eviction_sink = None
-        self.hot.close()
+
+    # -- pipelined-ingest passthrough (the pipeline lives on the hot
+    # store; collector/daemon wiring sees one store object) ------------
+
+    def start_pipeline(self, depth: Optional[int] = None):
+        return self.hot.start_pipeline(depth)
+
+    def drain_pipeline(self) -> None:
+        self.hot.drain_pipeline()
+
+    def stop_pipeline(self, raise_errors: bool = True) -> None:
+        self.hot.stop_pipeline(raise_errors)
+
+    def seal_barrier(self) -> None:
+        self.hot.seal_barrier()
 
     # -- row reads ------------------------------------------------------
 
+    def _segments(self):
+        """Directory snapshot behind the hot store's seal barrier:
+        with an async sealer a capture window can be pulled (rows
+        possibly already overwritten in the rings) but not yet
+        appended — a cold read that skipped the barrier could miss
+        rows neither tier still serves."""
+        self.hot.seal_barrier()
+        return self.archive.snapshot()
+
+    def _pruned(self, probe):
+        """Zone-pruned scan behind the seal barrier (see _segments)."""
+        self.hot.seal_barrier()
+        return self.archive.pruned_scan(probe)
+
     def _cold_segments_for_traces(self, qids: Set[int]):
-        return self.archive.pruned_scan(
+        return self._pruned(
             lambda seg: any(seg.zone.may_contain_trace(t) for t in qids)
         )
 
@@ -311,7 +344,7 @@ class TieredSpanStore(SpanStore):
                     ) -> List[IndexedTraceId]:
         t0 = time.perf_counter()
         cands = []
-        for seg in self.archive.pruned_scan(probe):
+        for seg in self._pruned(probe):
             _, _, spans = self.archive.decoded(seg)
             cands.extend(
                 (s.trace_id, s.last_timestamp) for s in matcher(spans)
@@ -373,7 +406,7 @@ class TieredSpanStore(SpanStore):
     def get_all_service_names(self) -> Set[str]:
         out = self.hot.get_all_service_names()
         d = self.hot.dicts.services
-        for seg in self.archive.snapshot():
+        for seg in self._segments():
             out.update(
                 name for i in seg.zone.service_ids
                 if i < len(d) and (name := d.decode(i))
@@ -385,7 +418,7 @@ class TieredSpanStore(SpanStore):
         svc = self.hot.dicts.services.get(service.lower())
         if svc is None:
             return out
-        for seg in self.archive.pruned_scan(
+        for seg in self._pruned(
                 lambda s: svc in s.zone.service_ids):
             _, _, spans = self.archive.decoded(seg)
             out.update(
@@ -431,7 +464,7 @@ class TieredSpanStore(SpanStore):
         if svc is None:
             return None
         counts = None
-        for seg in self.archive.snapshot():
+        for seg in self._segments():
             row = seg.zone.dur_hist.get(svc)
             if row is not None:
                 counts = row if counts is None else counts + row
@@ -444,7 +477,7 @@ class TieredSpanStore(SpanStore):
         """Distinct-trace estimate over the cold tier from merged
         segment HLLs."""
         regs = None
-        for seg in self.archive.snapshot():
+        for seg in self._segments():
             regs = (seg.zone.hll if regs is None
                     else SK.hll_merge(regs, seg.zone.hll))
         if regs is None:

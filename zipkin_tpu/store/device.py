@@ -3094,3 +3094,49 @@ def gather_trace_rows(
     )
 
 
+
+
+# ---------------------------------------------------------------------------
+# Pipelined-ingest staging + jit-compile accounting
+# ---------------------------------------------------------------------------
+
+
+def stage_batch(db: DeviceBatch) -> DeviceBatch:
+    """H2D staging of one padded batch: ``jax.device_put`` of the whole
+    pytree, returned immediately (the transfer proceeds asynchronously)
+    so the pipeline's stage thread can overlap the copy with the
+    previous fused step's device compute. Placement is left uncommitted
+    on the default device. NOTE: staged (device-resident) arguments
+    key DIFFERENT jit cache rows than host numpy arguments on this jax
+    version, so the first pipelined drive at a given pad bucket
+    compiles its own entry even if the serial path warmed that shape —
+    thereafter steady state is zero recompiles (gated via
+    ``compile_count`` in bench_smoke's pipeline phase, warmed through
+    the pipeline)."""
+    return jax.device_put(db)
+
+
+# The write-path jits whose compile-cache growth the ingest pipeline
+# gates on: steady-state pipelined ingest must hit only pow2 pad
+# buckets that warmup already compiled (zero recompiles). Query jits
+# are deliberately excluded — their cache is keyed by request shapes
+# the write path does not control.
+_INGEST_JITS = (
+    ingest_step, ingest_steps, dep_sweep, dep_close_bucket,
+    rebuild_span_tab, _capture_impl,
+)
+
+
+def compile_count() -> int:
+    """Total compiled variants (jit cache entries) across the ingest /
+    staging / capture jits — a process-wide monotone recompile counter.
+    Surfaced through ``TpuSpanStore.counters()`` -> /metrics as
+    ``jit_compiles``; bench_smoke's pipeline phase asserts its delta is
+    ZERO across a warmed pipelined drive."""
+    total = 0
+    for fn in _INGEST_JITS:
+        try:
+            total += fn._cache_size()
+        except Exception:  # pragma: no cover — jax internals moved
+            pass
+    return total

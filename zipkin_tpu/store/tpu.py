@@ -17,7 +17,9 @@ the streaming sketch state — see the ``analytics``-section methods.
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time as _time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
@@ -33,6 +35,11 @@ from zipkin_tpu.models.span import Span
 from zipkin_tpu.ops import hll
 from zipkin_tpu.ops import quantile as Q
 from zipkin_tpu.store import device as dev
+from zipkin_tpu.store.pipeline import (
+    EvictionSealer,
+    IngestPipeline,
+    IngestUnit,
+)
 from zipkin_tpu.columnar.encode import to_signed64
 from zipkin_tpu.concurrency import RWLock
 from zipkin_tpu.store.base import (
@@ -326,6 +333,24 @@ class TpuSpanStore(SpanStore):
         self._cap_upto = 0
         self._cap_a = 0
         self._cap_b = 0
+        # Async eviction sealing (store/pipeline.EvictionSealer): with
+        # capture_backlog > 0 the write path only PULLS a capture
+        # window (read-only launch, ordering invariant intact) and a
+        # background thread does the D2H + deflate + directory append.
+        # _sealed_upto trails _cap_upto by exactly the in-flight
+        # windows; checkpoint manifests cut at the SEALED frontier.
+        # _cap_lock serializes window capture between the serial write
+        # path (under _lock) and the pipeline's commit thread.
+        self.capture_backlog = self.CAPTURE_BACKLOG
+        self._sealer: Optional[EvictionSealer] = None
+        self._sealed_upto = 0
+        self._cap_lock = threading.Lock()
+        # Pipelined ingest (store/pipeline.IngestPipeline), opt-in via
+        # start_pipeline(): apply/write_thrift become stage 1 (encode +
+        # pad under _lock) and the commit thread owns the device write
+        # path (the _wp/_awp/_bwp mirrors, capture/archive triggers,
+        # sweep cadence).
+        self._pipeline: Optional[IngestPipeline] = None
         # Pending-sweep pacing: sweep every SWEEP_EVERY batches on the
         # write path (bounds how long a cross-batch child waits for its
         # link) and lazily before dependency reads — but only when
@@ -359,13 +384,30 @@ class TpuSpanStore(SpanStore):
         from zipkin_tpu import obs
 
         reg = registry or obs.default_registry()
+        self._registry = reg
+        # Launch dispatch is ASYNC under JAX, so a per-step wall clock
+        # only measures host dispatch. The true-latency sketch blocks
+        # on a tiny scalar every INGEST_SYNC_EVERY-th launch (sampled
+        # sync: negligible throughput tax, honest p50/p99); the
+        # dispatch sketch keeps the old always-on host-side number.
         self._h_ingest = reg.register(obs.LatencySketch(
             "zipkin_store_ingest_step_seconds",
-            "Device ingest launch latency (per fused step/chain, "
-            "dispatch + compute + host bookkeeping)"))
+            "TRUE fused-step latency, dispatch through device "
+            "completion (sampled: observed every "
+            f"{self.INGEST_SYNC_EVERY}th launch via a scalar sync)"))
+        self._h_dispatch = reg.register(obs.LatencySketch(
+            "zipkin_store_ingest_dispatch_seconds",
+            "Host dispatch time per fused step/chain (async: excludes "
+            "device compute — see zipkin_store_ingest_step_seconds)"))
         self._c_launches = reg.register(obs.Counter(
             "zipkin_store_ingest_launches_total",
             "Device ingest launches (chained chunks count as one)"))
+        self._launch_seq = 0
+        reg.register(obs.Counter(
+            "zipkin_store_jit_compiles_total",
+            "Compiled variants across the ingest/staging/capture jits "
+            "(dev.compile_count; steady-state pipelined ingest adds 0)",
+            fn=lambda: float(dev.compile_count())))
         # The zipkin_store_counter family is registered by ApiServer
         # from the generic counters() hook (one registration site for
         # every backend), not here.
@@ -385,6 +427,17 @@ class TpuSpanStore(SpanStore):
     # Bound on the host TTL map (pins + recent traces); ring eviction has
     # no host-side hook, so pruning happens on insert.
     MAX_TTL_ENTRIES = 1 << 20
+    # True-latency sampling cadence: every Nth launch blocks on one
+    # scalar (write_pos) to observe dispatch->completion. The first
+    # launch is always sampled so a single-write store still reports.
+    INGEST_SYNC_EVERY = 32
+    # Default prefetch depth for start_pipeline(None).
+    PIPELINE_DEPTH = 8
+    # Default async-seal backlog: 0 = seal inline on the write path
+    # (bitwise-deterministic timing, the library default); deployments
+    # that want capture off the critical path set capture_backlog > 0
+    # (the daemon's --capture-backlog does).
+    CAPTURE_BACKLOG = 0
 
     def apply(self, spans: Sequence[Span]) -> None:
         if not spans:
@@ -405,6 +458,9 @@ class TpuSpanStore(SpanStore):
             # Buffer at most one chain group (+ one trace chunk's worth)
             # of encoded columnar parts — a bulk apply() must not hold
             # the whole call's columnar copy in host memory at once.
+            if self._pipeline is not None:
+                self._apply_pipelined(spans)
+                return
             parts = []
             for part in self._chunk_by_trace(spans):
                 batch = self.codec.encode(part)
@@ -420,6 +476,43 @@ class TpuSpanStore(SpanStore):
                     parts = []
             if parts:
                 self._write_parts(parts)
+
+    def _apply_pipelined(self, spans: Sequence[Span]) -> None:
+        """Stage 1 of the ingest pipeline (caller thread, under the
+        encode lock): encode + index bits + pow2 padding, feeding the
+        prefetch queue. The chunk flush boundary, the CHAIN_SIZES
+        grouping, and the pad buckets are IDENTICAL to the serial
+        path's, so both modes cut the same launch units — the basis of
+        the pipelined-equals-serial bitwise guarantee
+        (tests/test_pipeline.py)."""
+        pipe = self._pipeline
+        self.ensure_writable()  # fail fast; the commit thread re-checks
+        t0 = _time.perf_counter()
+        stalled = 0.0
+        parts = []
+        for part in self._chunk_by_trace(spans):
+            batch = self.codec.encode(part)
+            indexable = np.fromiter(
+                (should_index(s) for s in part), bool, len(part)
+            )
+            name_lc = self._name_lc_ids(batch)
+            parts.extend(self._chunk_columnar(batch, name_lc, indexable))
+            if self.CHAIN_SIZES and len(parts) >= self.CHAIN_SIZES[0]:
+                stalled += self._feed_units(pipe, parts)
+                parts = []
+        if parts:
+            stalled += self._feed_units(pipe, parts)
+        pipe.h_encode.observe(
+            max(_time.perf_counter() - t0 - stalled, 0.0))
+
+    def _feed_units(self, pipe: IngestPipeline, parts) -> float:
+        """Pad + enqueue one flushed part list as launch units; returns
+        seconds spent blocked on pipeline backpressure (excluded from
+        the encode sketch)."""
+        stalled = 0.0
+        for group in self._plan_units(parts):
+            stalled += pipe.feed(self._pad_unit(group))
+        return stalled
 
     def _chunk_by_trace(self, spans: Sequence[Span]):
         chunk_size = self._max_chunk_spans()
@@ -470,6 +563,7 @@ class TpuSpanStore(SpanStore):
         from zipkin_tpu import native
 
         with self._lock:
+            t0 = _time.perf_counter()  # stage-1 clock (pipelined mode)
             batch, name_lc, dropped, kept_debug = (
                 native.parse_spans_columnar_sampled(
                     payload, self.dicts, sample_threshold,
@@ -495,9 +589,18 @@ class TpuSpanStore(SpanStore):
                     )
             self._prune_ttls()
             indexable = native.indexable_from_batch(batch, self.dicts)
-            self._write_parts(list(self._chunk_columnar(
-                batch, name_lc, indexable
-            )))
+            parts = list(self._chunk_columnar(batch, name_lc, indexable))
+            pipe = self._pipeline
+            if pipe is not None:
+                # t0 opened before the native parse: the encode sketch
+                # must cover the whole stage-1 body (parse + index
+                # bits + chunking + padding), not just the pad tail.
+                self.ensure_writable()
+                stalled = self._feed_units(pipe, parts)
+                pipe.h_encode.observe(
+                    max(_time.perf_counter() - t0 - stalled, 0.0))
+            else:
+                self._write_parts(parts)
             return batch.n_spans, dropped, kept_debug
 
     def _chunk_columnar(self, batch: SpanBatch, name_lc: np.ndarray,
@@ -601,6 +704,16 @@ class TpuSpanStore(SpanStore):
         one launch (result order implementation-defined on TPU) — callers
         must chunk; ``apply`` does.
         """
+        if self._pipeline is not None:
+            # Committing on the caller thread while the pipeline's
+            # commit thread is live would make two concurrent device
+            # writers (racing the mirror bumps and capture clocks) —
+            # the ring-scatter contract forbids it.
+            raise RuntimeError(
+                "write_batch commits inline and cannot run while an "
+                "ingest pipeline is active; use apply()/write_thrift "
+                "or stop_pipeline() first"
+            )
         c = self.config
         if (batch.n_spans > min(c.capacity, c.pending_slots)
                 or batch.n_annotations > c.ann_capacity
@@ -623,13 +736,27 @@ class TpuSpanStore(SpanStore):
         groups of equal-padded chunks into single ``dev.ingest_steps``
         launches — one ~100ms dispatch per GROUP instead of per chunk
         (NOTES_r03 §3 cost model; the ItemQueue batch-drain role,
-        ItemQueue.scala:39). Spans are bounded by capacity//2 so the
-        archive cadence (one dependency-bucket close per half ring) can
-        never be outrun inside one launch; annotation/binary rows are
-        bounded by their FULL ring capacities — a group exceeding one
-        would overwrite its own side rows mid-launch, where no capture
-        hook can run (the pre-launch capture trigger already protects
-        every OLDER uncaptured row up to exactly this bound)."""
+        ItemQueue.scala:39)."""
+        for group in self._plan_units(parts):
+            if len(group) == 1:
+                self._write_device(*group[0])
+            else:
+                self._write_device_many(group)
+
+    def _plan_units(self, parts):
+        """CHAIN_SIZES greedy grouping of chunker parts into launch
+        units — ONE policy shared by the serial writer and the ingest
+        pipeline's stage 1 (identical grouping is a precondition of
+        the pipelined-equals-serial bitwise guarantee). Spans are
+        bounded by capacity//2 so the archive cadence (one
+        dependency-bucket close per half ring) can never be outrun
+        inside one launch; annotation/binary rows are bounded by their
+        FULL ring capacities — a group exceeding one would overwrite
+        its own side rows mid-launch, where no capture hook can run
+        (the pre-launch capture trigger already protects every OLDER
+        uncaptured row up to exactly this bound). Yields part lists;
+        singletons dispatch via ingest_step, larger groups chain
+        through ingest_steps."""
         span_budget = max(1, self.config.capacity // 2)
         ann_budget = max(1, self.config.ann_capacity)
         bann_budget = max(1, self.config.bann_capacity)
@@ -646,26 +773,33 @@ class TpuSpanStore(SpanStore):
                         <= ann_budget
                         and sum(p[0].n_binary for p in group)
                         <= bann_budget):
-                    self._write_device_many(group)
+                    yield group
                     took = size
                     break
             else:
-                self._write_device(*parts[i])
+                yield parts[i:i + 1]
             i += took
 
-    def _write_device_many(self, group) -> None:
-        """One chained launch over ≥2 chunks: pad every chunk to the
-        group's max shapes, stack, and scan (dev.ingest_steps). Each
-        chunk individually satisfies the ring-capacity guards, and scan
-        steps run sequentially, so per-launch invariants match the
-        single-chunk path's."""
+    def _pad_unit(self, group) -> IngestUnit:
+        """Pad one planned group to its pow2 buckets (host numpy — the
+        H2D copy is the pipeline's stage 2, or implicit at dispatch on
+        the serial path). Chained groups pad every chunk to the group
+        max and stack along a leading scan axis. pow2 bucketing bounds
+        the jit compile cache, so a warmed steady state pads into
+        already-compiled shapes only (dev.compile_count gates this)."""
+        if len(group) == 1:
+            b, lc, ix = group[0]
+            db = dev.make_device_batch(
+                b, name_lc_id=lc, indexable=ix,
+                pad_spans=_next_pow2(b.n_spans),
+                pad_anns=_next_pow2(b.n_annotations),
+                pad_banns=_next_pow2(b.n_binary),
+            )
+            return IngestUnit(db, b.n_spans, b.n_annotations,
+                              b.n_binary, 1, False)
         pad_s = _next_pow2(max(b.n_spans for b, _, _ in group))
         pad_a = _next_pow2(max(b.n_annotations for b, _, _ in group))
         pad_b = _next_pow2(max(b.n_binary for b, _, _ in group))
-        self.ensure_writable()
-        import time as _time
-
-        t0 = _time.perf_counter()
         dbs = [
             dev.make_device_batch(
                 b, name_lc_id=lc, indexable=ix,
@@ -673,56 +807,69 @@ class TpuSpanStore(SpanStore):
             )
             for b, lc, ix in group
         ]
-        stacked = dev.stack_device_batches(dbs)
-        total = sum(b.n_spans for b, _, _ in group)
-        total_a = sum(b.n_annotations for b, _, _ in group)
-        total_b = sum(b.n_binary for b, _, _ in group)
-        self._maybe_capture(total, total_a, total_b)
-        self._maybe_archive(total)
+        return IngestUnit(
+            dev.stack_device_batches(dbs),
+            sum(b.n_spans for b, _, _ in group),
+            sum(b.n_annotations for b, _, _ in group),
+            sum(b.n_binary for b, _, _ in group),
+            len(group), True,
+        )
+
+    def _commit_unit(self, unit: IngestUnit) -> None:
+        """Stage 3 — the ONE device-commit body behind both write
+        modes: eviction-capture trigger, bucket-rotation trigger, the
+        donating state swap under the write lock, host mirror bumps,
+        and the sweep cadence. Serial writers run it inline under
+        self._lock; the pipeline's commit thread runs it alone (it is
+        the only device writer while a pipeline is active)."""
+        self.ensure_writable()
+        t0 = _time.perf_counter()
+        self._maybe_capture(unit.n_spans, unit.n_anns, unit.n_banns)
+        self._maybe_archive(unit.n_spans)
+        step = dev.ingest_steps if unit.chained else dev.ingest_step
         with self._rw.write():
-            self.state = dev.ingest_steps(self.state, stacked)
-        self._wp += total
-        self._awp += total_a
-        self._bwp += total_b
+            self.state = step(self.state, unit.db)
+        self._wp += unit.n_spans
+        self._awp += unit.n_anns
+        self._bwp += unit.n_banns
         self._step_seq += 1
-        self._observe_ingest(_time.perf_counter() - t0)
-        self._batches_since_sweep += len(group)
+        self._observe_ingest(t0)
+        self._batches_since_sweep += unit.n_parts
         if self._batches_since_sweep >= self.SWEEP_EVERY:
             self._sweep_pending()
+
+    def _write_device_many(self, group) -> None:
+        """One chained launch over ≥2 chunks: pad every chunk to the
+        group's max shapes, stack, and scan (dev.ingest_steps). Each
+        chunk individually satisfies the ring-capacity guards, and scan
+        steps run sequentially, so per-launch invariants match the
+        single-chunk path's."""
+        self._commit_unit(self._pad_unit(group))
 
     def _write_device(self, batch: SpanBatch, name_lc: np.ndarray,
                       indexable: np.ndarray) -> None:
         """Pad, upload, and run the fused ingest step for one chunk that
         already fits the ring capacities."""
-        self.ensure_writable()
-        import time as _time
+        self._commit_unit(self._pad_unit([(batch, name_lc, indexable)]))
 
-        t0 = _time.perf_counter()
-        db = dev.make_device_batch(
-            batch,
-            name_lc_id=name_lc,
-            indexable=indexable,
-            pad_spans=_next_pow2(batch.n_spans),
-            pad_anns=_next_pow2(batch.n_annotations),
-            pad_banns=_next_pow2(batch.n_binary),
-        )
-        self._maybe_capture(batch.n_spans, batch.n_annotations,
-                            batch.n_binary)
-        self._maybe_archive(batch.n_spans)
-        with self._rw.write():
-            self.state = dev.ingest_step(self.state, db)
-        self._wp += batch.n_spans
-        self._awp += batch.n_annotations
-        self._bwp += batch.n_binary
-        self._step_seq += 1
-        self._observe_ingest(_time.perf_counter() - t0)
-        self._batches_since_sweep += 1
-        if self._batches_since_sweep >= self.SWEEP_EVERY:
-            self._sweep_pending()
-
-    def _observe_ingest(self, dt_s: float) -> None:
-        self._h_ingest.observe(dt_s)
+    def _observe_ingest(self, t0: float) -> None:
+        """Launch accounting: always-on dispatch time, plus the TRUE
+        step latency every INGEST_SYNC_EVERY-th launch (block on the
+        write_pos scalar — one tiny D2H, no ring traffic). The old
+        single-sketch scheme timed only the async dispatch, so
+        /metrics showed host dispatch cost as if it were device
+        compute (the r9 underreporting fix)."""
+        self._h_dispatch.observe(_time.perf_counter() - t0)
         self._c_launches.inc()
+        self._launch_seq += 1
+        if self._launch_seq % self.INGEST_SYNC_EVERY == 1 \
+                or self.INGEST_SYNC_EVERY == 1:
+            # Under the read lock: a reader-triggered pending sweep
+            # (get_dependencies) is a DONATING step — blocking on a
+            # state the sweep just consumed would hit deleted buffers.
+            with self._rw.read():
+                jax.block_until_ready(self.state.write_pos)
+            self._h_ingest.observe(_time.perf_counter() - t0)
 
     # Write-path sweep cadence (batches). Each sweep is one small launch
     # over the pending ring; 64 bounds a cross-batch child's link
@@ -773,19 +920,25 @@ class TpuSpanStore(SpanStore):
                 and self._awp + n_a - self._cap_a <= c.ann_capacity
                 and self._bwp + n_b - self._cap_b <= c.bann_capacity):
             return
-        self._capture_window()
+        with self._cap_lock:
+            self._capture_window()
 
     def _capture_window(self) -> None:
-        """Pull + seal the whole uncaptured window [cap_upto, wp) —
-        the ONE capture body behind the write-path trigger and
-        capture_now. Runs under the writer lock: apply/write_thrift
-        hold self._lock around their whole write path (and direct
-        write_batch callers must serialize like any writer — two
-        concurrent writers already violate the ring-scatter uniqueness
-        contract), so clock reads, the pull, the sink append, and the
-        clock advance are atomic against every other writer AND against
-        checkpoint.save's manifest cut (which snapshots under the same
-        lock)."""
+        """Pull the whole uncaptured window [cap_upto, wp) — the ONE
+        capture body behind the write-path trigger and capture_now,
+        serialized by _cap_lock (the serial writer holds self._lock
+        too; the pipeline's commit thread holds only _cap_lock, and
+        capture_now drains the pipeline before taking it).
+
+        The PULL is synchronous — the captured-before-overwrite
+        ordering invariant requires the read-only launch to complete
+        before the overwriting step dispatches — but with
+        capture_backlog > 0 the captured rows stay DEVICE-resident and
+        the D2H + deflate + directory append move to the background
+        sealer (store/pipeline.EvictionSealer), whose bounded queue is
+        the only thing that can stall ingest. Capture outputs are
+        fresh arrays no ingest step ever donates, so the sealer needs
+        no store lock."""
         lo, hi = self._cap_upto, self._wp
         cap_anns = self._awp - self._cap_a
         cap_banns = self._bwp - self._cap_b
@@ -793,37 +946,75 @@ class TpuSpanStore(SpanStore):
             self._cap_upto, self._cap_a, self._cap_b = (
                 self._wp, self._awp, self._bwp)
             return
-        import time as _time
-
         t0 = _time.perf_counter()
-        batch, gids = self._pull_evicted_rows(lo, hi, cap_anns,
-                                              cap_banns)
-        self.eviction_sink(batch, gids, lo, hi,
-                           _time.perf_counter() - t0)
-        # Clocks advance only AFTER the pull and seal succeed: a
-        # transient device error mid-capture leaves the window
-        # uncaptured-but-resident, and the next write retries it —
-        # stamping first would silently skip it forever.
+        n_s, n_a, n_b, s_m, a_m, b_m = self._pull_evicted_rows(
+            lo, hi, cap_anns, cap_banns)
+        pull_s = _time.perf_counter() - t0
+        if self.capture_backlog and self.capture_backlog > 0:
+            if self._sealer is None:
+                self._sealer = EvictionSealer(
+                    self, backlog=self.capture_backlog,
+                    registry=self._registry)
+            self._sealer.submit(n_s, n_a, n_b, s_m, a_m, b_m,
+                                lo, hi, pull_s)
+        else:
+            batch, gids = mats_to_batch(
+                n_s, n_a, n_b, *jax.device_get((s_m, a_m, b_m)))
+            self.eviction_sink(batch, gids, lo, hi,
+                               _time.perf_counter() - t0)
+            self._note_sealed(lo, hi)
+        # Clocks advance only AFTER the pull succeeds: a transient
+        # device error mid-pull leaves the window uncaptured-but-
+        # resident, and the next write retries it — stamping first
+        # would silently skip it forever. (An ASYNC seal failure after
+        # a successful pull is counted + re-raised on the write path,
+        # but its window cannot be retried — the rows may already be
+        # overwritten; checkpoint cuts at the SEALED frontier so a
+        # snapshot never claims an unsealed window.)
         self._cap_upto, self._cap_a, self._cap_b = (
             self._wp, self._awp, self._bwp)
 
+    def _note_sealed(self, lo: int, hi: int) -> None:
+        """Advance the sealed frontier — every gid below it is durable
+        in the cold tier (called by the inline seal path and the
+        sealer thread). CONTIGUITY-GATED: if an earlier window's seal
+        failed (a hole — its rows are lost from the cold tier), the
+        frontier stays below the hole even as later windows seal, so a
+        checkpoint cut never claims the hole and a restore can
+        re-capture whatever of it the saved rings still held."""
+        if lo <= self._sealed_upto:
+            self._sealed_upto = max(self._sealed_upto, hi)
+
+    def seal_barrier(self) -> None:
+        """Wait until every pulled capture window is sealed (no-op
+        without an async sealer). Cold-tier reads and checkpoint cuts
+        run behind this so a captured row is never invisible."""
+        s = self._sealer
+        if s is not None:
+            s.drain()
+
     def capture_now(self) -> None:
-        """Flush the uncaptured window [cap_upto, write_pos) to the
-        eviction sink immediately — checkpoint restore uses this to
-        re-align the capture clocks (the ann/bann mirrors don't survive
-        a restart), and operators can call it to make the cold tier
-        current before a planned shutdown."""
+        """Flush the uncaptured window [cap_upto, write_pos) through
+        the eviction sink and wait for the seal — checkpoint restore
+        uses this to re-align the capture clocks (the ann/bann mirrors
+        don't survive a restart), and operators can call it to make
+        the cold tier current before a planned shutdown."""
         with self._lock:
             if self.eviction_sink is None:
                 return
-            self._capture_window()
+            self.drain_pipeline()
+            with self._cap_lock:
+                self._capture_window()
+            self.seal_barrier()
 
     def _pull_evicted_rows(self, lo: int, hi: int, n_anns: int,
                            n_banns: int):
-        """One capture window as (SpanBatch, gids): a single
-        dev.capture_eviction_rows launch + D2H. The host mirrors
-        predict the side-row counts exactly; the escalation loop is a
-        belt-and-braces guard, not the steady state."""
+        """One capture window as (n_s, n_a, n_b, span_mat, ann_mat,
+        bann_mat) with the row matrices still DEVICE-resident — only
+        the [3] count vector syncs, so the write path never waits on
+        the bulk D2H. The host mirrors predict the side-row counts
+        exactly; the escalation loop is a belt-and-braces guard, not
+        the steady state."""
         from zipkin_tpu.store.base import escalate_cap
 
         c = self.config
@@ -832,17 +1023,15 @@ class TpuSpanStore(SpanStore):
         k_b = min(_next_pow2(max(n_banns, 1)), c.bann_capacity)
         while True:
             with self._rw.read():
-                counts, s_m, a_m, b_m = jax.device_get(
-                    dev.capture_eviction_rows(self.state, lo, hi,
-                                              k_s, k_a, k_b)
-                )
-            n_s, n_a, n_b = (int(x) for x in counts)
+                counts, s_m, a_m, b_m = dev.capture_eviction_rows(
+                    self.state, lo, hi, k_s, k_a, k_b)
+                n_s, n_a, n_b = (
+                    int(x) for x in jax.device_get(counts))
             if n_s <= k_s and n_a <= k_a and n_b <= k_b:
-                break
+                return n_s, n_a, n_b, s_m, a_m, b_m
             k_s = escalate_cap(n_s, k_s, c.capacity)
             k_a = escalate_cap(n_a, k_a, c.ann_capacity)
             k_b = escalate_cap(n_b, k_b, c.bann_capacity)
-        return mats_to_batch(n_s, n_a, n_b, s_m, a_m, b_m)
 
     def adopt_state(self, state, spans_written: int,
                     archived: Optional[int] = None) -> None:
@@ -859,6 +1048,8 @@ class TpuSpanStore(SpanStore):
         unresolved pending children, so the first dependency read must
         run a pending sweep (the streaming-join contract) even though no
         store-mediated batch was ever written."""
+        self.drain_pipeline()
+        self.seal_barrier()
         self.ensure_writable()
         with self._rw.write():
             self.state = state
@@ -868,9 +1059,80 @@ class TpuSpanStore(SpanStore):
         self._batches_since_sweep = 1
         # The adopted state's history predates the sink: re-seed the
         # capture clocks so only post-adoption evictions are captured.
+        # The sealed frontier follows (nothing is pending: the barrier
+        # above drained the sealer).
         self._awp = self._bwp = 0
         self._cap_upto = self._wp
         self._cap_a = self._cap_b = 0
+        self._sealed_upto = self._cap_upto
+
+    # -- pipelined ingest lifecycle (store/pipeline) --------------------
+
+    def start_pipeline(self, depth: Optional[int] = None
+                       ) -> IngestPipeline:
+        """Switch the write path to the three-stage ingest pipeline:
+        apply/write_thrift become stage 1 (encode + pow2 pad, outside
+        the device critical section), a stage thread device_puts into
+        double-buffered staging slots, and a commit thread holds the
+        write lock only for the donating swap. ``depth`` bounds the
+        prefetch queue (the writer backpressure). Reads are untouched;
+        they see a consistent, possibly a-few-batches-stale state
+        until drain_pipeline(). See docs/INGEST_PIPELINE.md."""
+        with self._lock:
+            if self._pipeline is not None:
+                raise RuntimeError("ingest pipeline already running")
+            self._pipeline = IngestPipeline(
+                self, depth or self.PIPELINE_DEPTH,
+                registry=self._registry)
+            return self._pipeline
+
+    def drain_pipeline(self) -> None:
+        """Block until every accepted batch is committed to the device
+        (no-op when no pipeline is running); re-raises a parked
+        pipeline error. After it returns, reads see everything
+        apply() accepted before the call."""
+        p = self._pipeline
+        if p is not None:
+            p.drain()
+
+    def stop_pipeline(self, raise_errors: bool = True) -> None:
+        """Drain, stop the pipeline threads, and return the store to
+        the serial write path. The quiesce runs UNDER the encode lock
+        with the pipeline still published: unpublishing first would
+        let a writer blocked on _lock fall through to the serial path
+        and commit concurrently with the commit thread's remaining
+        queued units — two device writers, which the ring-scatter
+        contract forbids. Writers block on _lock until the commit
+        thread has fully stopped (it never takes _lock, so this cannot
+        deadlock)."""
+        with self._lock:
+            p = self._pipeline
+            if p is None:
+                return
+            p.stop()
+            self._pipeline = None
+        err = p.take_error()
+        if raise_errors and err is not None:
+            raise err
+
+    @contextlib.contextmanager
+    def pipelined(self, depth: Optional[int] = None):
+        """Scoped pipelined ingest: ``with store.pipelined(8): ...`` —
+        drains and stops on exit (re-raising any parked error)."""
+        pipe = self.start_pipeline(depth)
+        try:
+            yield pipe
+        finally:
+            self.stop_pipeline()
+
+    def close(self) -> None:
+        """Stop the pipeline (draining accepted batches) and the
+        capture sealer (sealing pulled windows) — nothing accepted or
+        captured is dropped on an orderly shutdown."""
+        self.stop_pipeline(raise_errors=False)
+        s, self._sealer = self._sealer, None
+        if s is not None:
+            s.stop()
 
     # TTLs above the per-write default mark a trace pinned: its spans are
     # materialized to the host pin bank so ring eviction can't drop them.
@@ -1314,6 +1576,7 @@ class TpuSpanStore(SpanStore):
         hourly-aggregation-timer role of zipkin-deployment-web's
         AnormAggregator schedule)."""
         with self._lock:
+            self.drain_pipeline()
             self.ensure_writable()
             with self._rw.write():
                 self.state = dev.dep_close_bucket(self.state)
@@ -1430,6 +1693,16 @@ class TpuSpanStore(SpanStore):
         out["banns_truncated"] = float(self.banns_truncated)
         out["index_hits"] = float(self.index_hits)
         out["index_scan_fallbacks"] = float(self.index_fallbacks)
+        # jit cache-miss tracking for the ingest/staging jits: a warmed
+        # pipelined steady state must hold this flat (bench_smoke's
+        # pipeline phase gates the delta at zero).
+        out["jit_compiles"] = float(dev.compile_count())
+        p = self._pipeline
+        if p is not None:
+            out["pipeline_prefetch_depth"] = float(p.queued())
+        s = self._sealer
+        if s is not None:
+            out["capture_backlog"] = float(s.queued())
         return out
 
     def stored_span_count(self) -> float:
