@@ -879,6 +879,120 @@ def bench_pipeline(total_spans: int = 100_000, depth: int = 8,
     return out
 
 
+def bench_durability(total_spans: int = 100_000):
+    """Durability phase (r10 tentpole, zipkin_tpu.wal): what the
+    write-ahead log costs on the ingest path and buys at recovery.
+    Measures the same span stream through a plain store (baseline +
+    oracle) and through WAL-attached stores at each fsync policy
+    (group-commit interval = the daemon default, off, and per-batch at
+    a quarter of the stream — per-append fsync is the worst case and
+    needs no full-length drive to characterize), then closes the log,
+    reopens it cold, and times a full-log recovery into a fresh store,
+    gating bitwise identity against the uncrashed oracle. Process-
+    death coverage is tests/test_crash.py; this phase puts NUMBERS on
+    the contract: append overhead per policy, WAL bytes/span on disk,
+    recovery spans/s."""
+    import shutil
+    import tempfile
+
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.testing.crash import states_bitwise_equal
+    from zipkin_tpu.tracegen import generate_traces
+    from zipkin_tpu.wal import WriteAheadLog, recover
+
+    cap = 1 << max(12, total_spans.bit_length() - 1)
+    config = dev.StoreConfig(
+        capacity=cap, ann_capacity=4 * cap, bann_capacity=2 * cap,
+        max_services=64, max_span_names=256,
+        max_annotation_values=512, max_binary_keys=64,
+        cms_width=1 << 12, hll_p=10, quantile_buckets=512,
+    )
+    _log(f"durability phase: {total_spans} spans, ring 2^"
+         f"{cap.bit_length() - 1}")
+    spans = []
+    while len(spans) < total_spans:
+        spans.extend(
+            s for t in generate_traces(
+                n_traces=max(total_spans // 5, 64), max_depth=3,
+                n_services=32,
+            ) for s in t
+        )
+    spans = spans[:total_spans]
+    chunk = 1024
+
+    def stream(store, n=None):
+        sub = spans if n is None else spans[:n]
+        t0 = time.perf_counter()
+        for i in range(0, len(sub), chunk):
+            store.apply(sub[i:i + chunk])
+        return time.perf_counter() - t0
+
+    root = tempfile.mkdtemp(prefix="wal-bench-")
+    try:
+        stream(TpuSpanStore(config))  # jit warm-up (uncounted)
+        oracle = TpuSpanStore(config)
+        base_s = stream(oracle)
+
+        def wal_drive(fsync, n=None, tag=""):
+            store = TpuSpanStore(config)
+            wal = WriteAheadLog(
+                os.path.join(root, f"wal-{fsync}{tag}"), fsync=fsync)
+            store.attach_wal(wal)
+            dt = stream(store, n)
+            wal.sync()
+            return store, wal, dt
+
+        s_int, wal_int, interval_s = wal_drive("interval")
+        _, wal_off, off_s = wal_drive("off")
+        n_batch = max(chunk, total_spans // 4)
+        _, wal_b, batch_s = wal_drive("batch", n=n_batch)
+        base_batch_s = base_s * n_batch / total_spans
+
+        wal_stats = wal_int.stats()
+        wal_dir = wal_int.directory
+        for w in (wal_int, wal_off, wal_b):
+            w.close()
+
+        # Cold recovery: reopen the log (open-time torn-tail scan
+        # included) and replay everything into a fresh store.
+        t0 = time.perf_counter()
+        wal2 = WriteAheadLog(wal_dir, fsync="off")
+        rec, rstats = recover(
+            None, wal2, fresh_store=lambda: TpuSpanStore(config))
+        recovery_s = time.perf_counter() - t0
+        identical = states_bitwise_equal(oracle.state, rec.state)
+        wal2.close()
+        append_ms = _sketch_ms(wal_int.h_append)
+        return {
+            "spans": total_spans,
+            "baseline_ingest_s": round(base_s, 2),
+            "wal_interval_ingest_s": round(interval_s, 2),
+            "wal_off_ingest_s": round(off_s, 2),
+            "wal_batch_ingest_s": round(batch_s, 2),
+            "wal_batch_spans": n_batch,
+            "append_overhead_interval_pct": round(
+                100.0 * (interval_s - base_s) / base_s, 1),
+            "append_overhead_off_pct": round(
+                100.0 * (off_s - base_s) / base_s, 1),
+            "append_overhead_batch_pct": round(
+                100.0 * (batch_s - base_batch_s) / base_batch_s, 1),
+            "wal_mb": round(wal_stats["wal_bytes"] / 1e6, 2),
+            "wal_bytes_per_span": round(
+                wal_stats["wal_bytes"] / total_spans, 1),
+            "wal_segments": wal_stats["wal_segments"],
+            "recovery_s": round(recovery_s, 2),
+            "recovery_spans_per_s": round(
+                rstats["replayed_spans"] / max(rstats["replay_s"],
+                                               1e-9), 1),
+            "replayed_records": rstats["replayed_records"],
+            "recovered_identical": bool(identical),
+            "wal_append_ms": append_ms,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _sketch_ms(sketch) -> dict:
     """Latency sketch snapshot with the time keys scaled to ms."""
     return {
@@ -1186,6 +1300,15 @@ def main():
                 depth=args.pipeline_depth),
             timeout_s=900, label="pipeline")
         emit("stream+queries+exactness+archive+pipeline")
+        # Durability (r10 tentpole, zipkin_tpu.wal): append overhead
+        # per fsync policy, WAL bytes/span, cold recovery rate, and
+        # bitwise recovered==oracle identity. Bounded like its
+        # neighbors — a failure here must not strand the core phases.
+        detail["durability_wal"] = _bounded(
+            lambda: bench_durability(
+                int(2e4) if args.smoke else int(2e5)),
+            timeout_s=900, label="durability")
+        emit("stream+queries+exactness+archive+pipeline+durability")
         # The XLA-vs-pallas kernel decision was measured and recorded in
         # round 4 (xla 158.6k vs pallas 155.0k spans/s, NOTES_r04 §3);
         # re-measuring it on every full run cost two extra compile+

@@ -110,6 +110,18 @@ _SLOW_TESTS = {
     "test_pipelined_capture_matches_inline_sealing",
     "test_capture_backpressure_bounds_memory",
     "test_checkpoint_during_pipelined_ingest",
+    # Crash-injection matrix (tests/test_crash.py): each case SIGKILLs
+    # a real child drive, then recovers + re-drives an oracle. The
+    # after-append smoke stays in tier-1; the rest of the kill-point
+    # matrix (checkpoint swaps, truncation, cold-tier sealing) is here.
+    "test_crash_before_append_loses_only_the_unacked_batch",
+    "test_crash_after_commit_before_ack",
+    "test_crash_mid_first_checkpoint_recovers_from_wal_alone",
+    "test_crash_mid_second_checkpoint_falls_back_to_old",
+    "test_crash_mid_truncate_leaves_recoverable_suffix",
+    "test_crash_mid_seal_replays_capture_and_cold_tier",
+    "test_crash_mid_seal_with_checkpoint",
+    "test_clean_child_exits_zero",
 }
 
 

@@ -90,3 +90,21 @@ def test_bench_smoke_json_and_op_ceilings():
     assert pp["capture_stall_s"] == 0, pp
     assert pp["windows_sealed"] >= 1, pp
     assert pp["pipelined_ingest_s"] > 0 and pp["serial_ingest_s"] > 0
+    # Durability phase (r10 tentpole): a full-log replay into a fresh
+    # store must land a BITWISE identical state (the half of the
+    # ack-after-append contract a live process can prove without
+    # dying — SIGKILL coverage is tests/test_crash.py), journaling
+    # must add zero jit recompiles in steady state and replay zero
+    # more, and the append overhead must hold the acceptance budget:
+    # <= 10% at the group-commit default, with fsync=off reproducing
+    # the no-WAL throughput (paired per-round ratios over interleaved
+    # drives keep these ratios honest on a noisy CI host).
+    w = rec["wal"]
+    assert w["replay_identical"] is True, w
+    assert w["steady_state_recompiles"] == 0, w
+    assert w["replay_recompiles"] == 0, w
+    assert w["replayed_records"] >= 1, w
+    assert w["append_overhead_interval"] <= 0.10, w
+    assert w["append_overhead_off"] <= 0.10, w
+    assert w["wal_bytes_per_span"] > 0, w
+    assert w["recovery_s"] > 0 and w["replay_spans_per_s"] > 0, w

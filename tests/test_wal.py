@@ -1,0 +1,688 @@
+"""Durable write-ahead log (zipkin_tpu.wal): framing, policies,
+torn-tail semantics, the unit record codec, deterministic recovery,
+slab integrity, and the collector's quiesce ordering.
+
+Process-death coverage (real SIGKILL at named points) lives in
+tests/test_crash.py; this file proves the same contracts at the
+library layer, where every failure mode can be constructed byte by
+byte.
+"""
+
+import os
+import struct
+import zipfile
+
+import numpy as np
+import pytest
+
+from zipkin_tpu import checkpoint
+from zipkin_tpu.checkpoint import CorruptSlabError
+from zipkin_tpu.columnar.dictionary import DictionarySet
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.testing.crash import (
+    build_crash_store,
+    crash_batches,
+    states_bitwise_equal,
+)
+from zipkin_tpu.wal import (
+    FsyncPolicy,
+    WalReplayError,
+    WriteAheadLog,
+    recover,
+    replay_into,
+)
+from zipkin_tpu.wal import record as walrec
+from zipkin_tpu.wal.log import _MAGIC, _REC
+
+
+# ---------------------------------------------------------------------------
+# Log framing + policies (byte-level, no device)
+# ---------------------------------------------------------------------------
+
+
+def _payloads(n, size=64):
+    return [bytes([i % 251]) * size + i.to_bytes(4, "big")
+            for i in range(n)]
+
+
+class TestLogFraming:
+    def test_append_replay_roundtrip(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync="batch")
+        pays = _payloads(7)
+        seqs = [wal.append(p) for p in pays]
+        assert seqs == list(range(1, 8))
+        assert wal.last_seq == 7
+        # batch policy: append returning means durable
+        assert wal.durable_seq == 7
+        got = list(wal.replay(0))
+        assert got == list(zip(range(1, 8), pays))
+        # from_seq skips the covered prefix
+        assert list(wal.replay(5)) == list(zip((6, 7), pays[5:]))
+        wal.close()
+
+    def test_reopen_resumes_sequences(self, tmp_path):
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="batch")
+        for p in _payloads(3):
+            wal.append(p)
+        wal.close()
+        wal2 = WriteAheadLog(d, fsync="batch")
+        assert wal2.last_seq == 3
+        assert wal2.append(b"next") == 4
+        assert [s for s, _ in wal2.replay(0)] == [1, 2, 3, 4]
+        wal2.close()
+
+    def test_segment_roll_and_cross_segment_replay(self, tmp_path):
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="off", segment_bytes=1 << 12,
+                            compress=False)
+        pays = _payloads(40, size=300)  # ~13 segments
+        for p in pays:
+            wal.append(p)
+        wal.sync()
+        segs = [n for n in os.listdir(d) if n.endswith(".seg")]
+        assert len(segs) > 3
+        assert [p for _, p in wal.replay(0)] == pays
+        wal.close()
+        # a fresh open over many segments sees the same prefix
+        wal2 = WriteAheadLog(d, fsync="off")
+        assert wal2.last_seq == 40
+        assert [p for _, p in wal2.replay(35)] == pays[35:]
+        wal2.close()
+
+    def test_torn_tail_garbage_is_cut(self, tmp_path):
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="batch")
+        pays = _payloads(5)
+        for p in pays:
+            wal.append(p)
+        wal.close()
+        seg = os.path.join(d, sorted(os.listdir(d))[0])
+        with open(seg, "ab") as f:
+            f.write(b"\x00\x00\x00\x10partial-frame-garbage")
+        wal2 = WriteAheadLog(d, fsync="batch")
+        assert wal2.last_seq == 5
+        assert wal2.torn_records_cut >= 1
+        assert [p for _, p in wal2.replay(0)] == pays
+        # the cut is PHYSICAL: a third open sees a clean file
+        wal2.close()
+        wal3 = WriteAheadLog(d, fsync="batch")
+        assert wal3.torn_records_cut == 0
+        wal3.close()
+
+    def test_torn_mid_record_truncates_to_prefix(self, tmp_path):
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="batch", compress=False)
+        pays = _payloads(5)
+        for p in pays:
+            wal.append(p)
+        wal.close()
+        seg = os.path.join(d, sorted(os.listdir(d))[0])
+        # chop into the final record's payload
+        with open(seg, "r+b") as f:
+            f.truncate(os.path.getsize(seg) - 10)
+        wal2 = WriteAheadLog(d, fsync="batch")
+        assert wal2.last_seq == 4
+        assert [p for _, p in wal2.replay(0)] == pays[:4]
+        wal2.close()
+
+    def test_crc_corrupt_middle_record_cuts_everything_after(
+            self, tmp_path):
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="off", segment_bytes=1 << 12,
+                            compress=False)
+        pays = _payloads(30, size=300)
+        for p in pays:
+            wal.append(p)
+        wal.sync()
+        wal.close()
+        segs = sorted(n for n in os.listdir(d) if n.endswith(".seg"))
+        assert len(segs) >= 3
+        victim = os.path.join(d, segs[1])
+        # flip one payload byte in the middle segment's first record
+        hdr_end = len(_MAGIC) + 4 + len(
+            b'{"version":1,"base_seq":%d}' % 0)  # recompute below
+        with open(victim, "r+b") as f:
+            head = f.read(len(_MAGIC) + 4)
+            (hlen,) = struct.unpack(">I", head[len(_MAGIC):])
+            hdr_end = len(_MAGIC) + 4 + hlen
+            f.seek(hdr_end + _REC.size + 5)
+            b = f.read(1)
+            f.seek(hdr_end + _REC.size + 5)
+            f.write(bytes([b[0] ^ 0xFF]))
+        wal2 = WriteAheadLog(d, fsync="off")
+        # prefix semantics: nothing at or past the corrupt record
+        # survives, INCLUDING later (intact) segments
+        survivors = [p for _, p in wal2.replay(0)]
+        assert survivors == pays[:len(survivors)]
+        assert len(survivors) < 30
+        assert wal2.torn_records_cut >= 1
+        names = sorted(n for n in os.listdir(d) if n.endswith(".seg"))
+        assert names[-1] == segs[1] or len(names) < len(segs)
+        wal2.close()
+
+    def test_sequence_hole_between_segments_cuts(self, tmp_path):
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="off", segment_bytes=1 << 12,
+                            compress=False)
+        for p in _payloads(30, size=300):
+            wal.append(p)
+        wal.sync()
+        wal.close()
+        segs = sorted(n for n in os.listdir(d) if n.endswith(".seg"))
+        assert len(segs) >= 3
+        os.remove(os.path.join(d, segs[1]))  # hole in the middle
+        wal2 = WriteAheadLog(d, fsync="off")
+        first_n = len(list(wal2.replay(0)))
+        assert 0 < first_n < 30  # only segment 0's prefix survives
+        assert wal2.torn_records_cut >= 1
+        wal2.close()
+
+    def test_compressed_payload_roundtrip(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync="batch",
+                            compress=True)
+        pay = b"abcdefgh" * 4096  # 32 KB, highly compressible
+        wal.append(pay)
+        seg = os.path.join(wal.directory, sorted(
+            os.listdir(wal.directory))[0])
+        assert os.path.getsize(seg) < len(pay) // 4
+        assert list(wal.replay(0)) == [(1, pay)]
+        wal.close()
+
+
+class TestPoliciesAndTruncation:
+    def test_interval_group_commit_advances_durable(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync="interval",
+                            interval_s=0.01)
+        seq = wal.append(b"x" * 100)
+        assert wal.wait_durable(seq, timeout=10.0)
+        assert wal.durable_seq >= seq
+        wal.close()
+
+    def test_off_policy_tracks_append_frontier(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync="off")
+        seq = wal.append(b"y" * 100)
+        assert wal.durable_seq == seq  # page-cache durability
+        wal.close()
+
+    def test_sync_is_an_explicit_barrier(self, tmp_path):
+        # a group-commit cadence too slow for the test must not matter
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync="interval",
+                            interval_s=30.0)
+        seq = wal.append(b"z" * 100)
+        wal.sync()
+        assert wal.durable_seq >= seq
+        wal.close()
+
+    def test_bad_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            WriteAheadLog(str(tmp_path / "w"), fsync="sometimes")
+
+    def test_truncate_deletes_covered_segments(self, tmp_path):
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="off", segment_bytes=1 << 12,
+                            compress=False)
+        pays = _payloads(30, size=300)
+        for p in pays:
+            wal.append(p)
+        wal.sync()
+        before = len([n for n in os.listdir(d) if n.endswith(".seg")])
+        removed = wal.truncate(upto_seq=20)
+        assert removed >= 1
+        after = len([n for n in os.listdir(d) if n.endswith(".seg")])
+        assert after < before
+        # replay past the checkpoint frontier still intact
+        assert [p for _, p in wal.replay(20)] == pays[20:]
+        # appends continue normally after truncation
+        assert wal.append(b"tail") == 31
+        wal.close()
+
+    def test_truncate_everything_rolls_active_segment(self, tmp_path):
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="batch", compress=False)
+        for p in _payloads(5):
+            wal.append(p)
+        wal.truncate(upto_seq=5)
+        assert list(wal.replay(0)) == []
+        assert wal.append(b"after") == 6  # sequences never reset
+        assert list(wal.replay(0)) == [(6, b"after")]
+        wal.close()
+
+    def test_truncate_on_reopened_log_preserves_sequence_chain(
+            self, tmp_path):
+        """A reopened log that has NOT appended yet (file not open —
+        the daemon's read-mostly window after boot replay) must still
+        roll before a full truncation: deleting every segment would
+        leave no record of _next_seq, the next open would restart at
+        seq 1 below the checkpoint's applied frontier, and recovery
+        would silently skip that many durably-acked records."""
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="off")
+        for p in _payloads(5):
+            wal.append(p)
+        wal.close()
+        re = WriteAheadLog(d, fsync="off")  # replay-only: no appends
+        assert re.last_seq == 5
+        re.truncate(upto_seq=5)  # the periodic checkpoint fires
+        re.close()
+        again = WriteAheadLog(d, fsync="off")
+        assert again.last_seq == 5  # chain survived the full wipe
+        assert again.append(b"after") == 6
+        again.close()
+
+    def test_append_after_close_raises(self, tmp_path):
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync="batch")
+        wal.close()
+        with pytest.raises(RuntimeError):
+            wal.append(b"late")
+
+
+class _HalfWriteFile:
+    """Wraps the segment file: the first write lands HALF the frame
+    then raises (the ENOSPC shape) — later writes pass through."""
+
+    def __init__(self, f):
+        self._f = f
+        self.fail = True
+
+    def write(self, b):
+        if self.fail:
+            self.fail = False
+            self._f.write(b[:len(b) // 2])
+            self._f.flush()
+            raise OSError(28, "No space left on device")
+        return self._f.write(b)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+class TestWriteFailures:
+    def test_failed_append_rolls_back_the_torn_frame(self, tmp_path):
+        from zipkin_tpu.wal.log import WalDurabilityError
+
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="batch", compress=False)
+        pays = _payloads(3)
+        wal.append(pays[0])
+        wal._file = _HalfWriteFile(wal._file)
+        # the failed append surfaces (no ack) and did NOT consume a seq
+        with pytest.raises(WalDurabilityError):
+            wal.append(pays[1])
+        # the torn half-frame was truncated away: the next append gets
+        # seq 2 and a crash+reopen sees a clean two-record prefix —
+        # without the rollback, this append would sit past torn bytes
+        # and be silently cut at recovery despite being acked
+        assert wal.append(pays[2]) == 2
+        wal.close()
+        wal2 = WriteAheadLog(d, fsync="batch")
+        assert wal2.torn_records_cut == 0
+        assert [p for _, p in wal2.replay(0)] == [pays[0], pays[2]]
+        wal2.close()
+
+    def test_unrollbackable_append_failure_poisons_the_log(
+            self, tmp_path):
+        from zipkin_tpu.wal.log import WalDurabilityError
+
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync="batch",
+                            compress=False)
+        wal.append(b"ok" * 50)
+        broken = _HalfWriteFile(wal._file)
+        broken.truncate = lambda *_: (_ for _ in ()).throw(
+            OSError("truncate failed too"))
+        wal._file = broken
+        with pytest.raises(WalDurabilityError):
+            wal.append(b"x" * 100)
+        # torn bytes are still on disk and could not be removed: every
+        # later append would be silently cut at recovery — refuse all
+        with pytest.raises(WalDurabilityError, match="poisoned"):
+            wal.append(b"y" * 100)
+
+    def test_group_commit_survives_fsync_errors_and_surfaces_them(
+            self, tmp_path, monkeypatch):
+        import time as _t
+
+        from zipkin_tpu.wal import log as wal_log
+        from zipkin_tpu.wal.log import WalDurabilityError
+
+        wal = WriteAheadLog(str(tmp_path / "w"), fsync="interval",
+                            interval_s=0.01)
+        real_fsync = wal_log.os.fsync
+        failing = [True]
+
+        def flaky_fsync(fd):
+            if failing[0]:
+                raise OSError(5, "Input/output error")
+            return real_fsync(fd)
+
+        monkeypatch.setattr(wal_log.os, "fsync", flaky_fsync)
+        seq = wal.append(b"z" * 100)
+        # while fsync fails, the acker is told — not left to time out
+        # against a silently dead group-commit thread
+        with pytest.raises(WalDurabilityError):
+            deadline = _t.monotonic() + 10.0
+            while _t.monotonic() < deadline:
+                if wal.wait_durable(seq, timeout=0.2):
+                    raise AssertionError("became durable while "
+                                         "fsync was failing")
+        # the error was TRANSIENT: the sync thread retried, recovered,
+        # and the frontier advances
+        failing[0] = False
+        assert wal.wait_durable(seq, timeout=10.0)
+        assert wal.durable_seq >= seq
+        wal.close()
+
+
+# ---------------------------------------------------------------------------
+# Unit record codec + dictionary delta lineage
+# ---------------------------------------------------------------------------
+
+
+class TestRecordCodec:
+    def _unit(self):
+        """One real stage-1 launch group via the columnar generator."""
+        from zipkin_tpu.tracegen import ColumnarTraceGen
+
+        dicts = DictionarySet()
+        before = walrec.dict_sizes(dicts)
+        gen = ColumnarTraceGen(dicts, n_services=4, n_span_names=8,
+                               spans_per_trace=3)
+        group = [gen.next_batch(4), gen.next_batch(3)]
+        return dicts, before, group
+
+    def test_encode_decode_roundtrip(self):
+        dicts, before, group = self._unit()
+        sizes, deltas = walrec.dump_dict_deltas(dicts, before)
+        payload = walrec.encode_unit(group, before, deltas)
+        got_group, got_before, got_deltas = walrec.decode_unit(payload)
+        assert got_before == before
+        assert len(got_group) == len(group)
+        cols = (type(group[0][0]).SPAN_COLUMNS
+                + type(group[0][0]).ANN_COLUMNS
+                + type(group[0][0]).BANN_COLUMNS)
+        for (b1, lc1, ix1), (b2, lc2, ix2) in zip(group, got_group):
+            for col in cols:
+                np.testing.assert_array_equal(
+                    getattr(b1, col), getattr(b2, col), err_msg=col)
+            np.testing.assert_array_equal(lc1, lc2)
+            np.testing.assert_array_equal(ix1, ix2)
+        # the delta rebuilds identical id assignment in a fresh set
+        fresh = DictionarySet()
+        walrec.apply_dict_deltas(fresh, got_before, got_deltas)
+        for name in walrec.DICT_NAMES:
+            assert (getattr(fresh, name).values()
+                    == getattr(dicts, name).values()), name
+
+    def test_unknown_version_fails_fast(self):
+        dicts, before, group = self._unit()
+        _, deltas = walrec.dump_dict_deltas(dicts, before)
+        payload = bytearray(walrec.encode_unit(group, before, deltas))
+        # bump the meta version in place
+        payload[payload.index(b'"v":1') + 4] = ord("9")
+        with pytest.raises(WalReplayError, match="version"):
+            walrec.decode_unit(bytes(payload))
+
+    def test_delta_against_shorter_dicts_is_lineage_error(self):
+        dicts = DictionarySet()
+        dicts.services.encode("svc-a")
+        sizes, deltas = walrec.dump_dict_deltas(
+            dicts, [1, 0, 0, 0, 0, 0])
+        fresh = DictionarySet()  # has 0 services, record expects 1
+        with pytest.raises(WalReplayError, match="lineage"):
+            walrec.apply_dict_deltas(fresh, [1, 0, 0, 0, 0, 0], deltas)
+
+    def test_conflicting_existing_entry_is_lineage_error(self):
+        dicts = DictionarySet()
+        dicts.services.encode("svc-a")
+        _, deltas = walrec.dump_dict_deltas(dicts, [0, 0, 0, 0, 0, 0])
+        other = DictionarySet()
+        other.services.encode("svc-DIFFERENT")
+        with pytest.raises(WalReplayError, match="lineage"):
+            walrec.apply_dict_deltas(other, [0, 0, 0, 0, 0, 0], deltas)
+
+    def test_verified_replay_over_existing_entries(self):
+        # checkpoint dictionaries can run AHEAD of the applied seq;
+        # replaying a delta whose entries already exist verifies them
+        dicts = DictionarySet()
+        dicts.services.encode("svc-a")
+        _, deltas = walrec.dump_dict_deltas(dicts, [0, 0, 0, 0, 0, 0])
+        walrec.apply_dict_deltas(dicts, [0, 0, 0, 0, 0, 0], deltas)
+        assert dicts.services.values() == ["svc-a"]  # no duplicate
+
+
+# ---------------------------------------------------------------------------
+# Recovery: checkpoint + tail replay == uncrashed oracle (device path)
+# ---------------------------------------------------------------------------
+
+
+def _drive(store, batches):
+    for b in batches:
+        store.apply(b)
+
+
+class TestRecovery:
+    def test_checkpoint_plus_tail_replay_is_bitwise_identical(
+            self, tmp_path):
+        batches = crash_batches(8)
+        oracle = build_crash_store(False)
+        _drive(oracle, batches)
+
+        store = build_crash_store(False)
+        wal = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+        store.attach_wal(wal)
+        _drive(store, batches[:4])
+        stats = checkpoint.save(store, str(tmp_path / "ckpt"))
+        # checkpoint-coordinated truncation ran (covered prefix gone)
+        assert "wal_truncated_segments" in stats
+        _drive(store, batches[4:])
+        wal.sync()
+        del store  # crash: HBM state gone, log + snapshot survive
+
+        wal2 = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+        rec, rstats = recover(str(tmp_path / "ckpt"), wal2)
+        assert rstats["applied_seq"] == 8
+        assert rstats["replayed_records"] == 4
+        assert states_bitwise_equal(oracle.state, rec.state)
+        # the recovered store keeps journaling: live appends continue
+        rec.apply(batches[0])
+        assert wal2.last_seq == 9
+        wal2.close()
+
+    def test_pipelined_drive_recovers_from_empty(self, tmp_path):
+        batches = crash_batches(6)
+        oracle = build_crash_store(False)
+        _drive(oracle, batches)
+
+        store = build_crash_store(False)
+        wal = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+        store.attach_wal(wal)
+        store.start_pipeline(4)
+        _drive(store, batches)
+        store.drain_pipeline()
+        wal.sync()
+        del store  # crash with NO checkpoint at all
+
+        wal2 = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+        rec, rstats = recover(
+            None, wal2, fresh_store=lambda: build_crash_store(False))
+        assert rstats["replayed_records"] == 6
+        assert states_bitwise_equal(oracle.state, rec.state)
+        assert int(wal2.c_replayed.value) == 6
+        wal2.close()
+
+    def test_torn_tail_batch_is_absent_not_partial(self, tmp_path):
+        batches = crash_batches(6)
+        store = build_crash_store(False)
+        wal = WriteAheadLog(str(tmp_path / "wal"), fsync="off",
+                            compress=False)
+        store.attach_wal(wal)
+        _drive(store, batches)
+        wal.sync()
+        wal.close()
+        del store
+        # tear the final record mid-payload (crash mid-append)
+        d = str(tmp_path / "wal")
+        seg = os.path.join(d, sorted(os.listdir(d))[-1])
+        with open(seg, "r+b") as f:
+            f.truncate(os.path.getsize(seg) - 64)
+
+        wal2 = WriteAheadLog(d, fsync="off")
+        rec, rstats = recover(
+            None, wal2, fresh_store=lambda: build_crash_store(False))
+        assert rstats["applied_seq"] == 5
+        oracle = build_crash_store(False)
+        _drive(oracle, batches[:5])
+        assert states_bitwise_equal(oracle.state, rec.state)
+        # the torn batch: provably absent, not partially applied
+        missing = sorted({s.trace_id for s in batches[5]})
+        assert not any(rec.get_spans_by_trace_ids(missing))
+        wal2.close()
+
+    def test_foreign_log_lineage_fails_fast(self, tmp_path):
+        batches = crash_batches(3)
+        store = build_crash_store(False)
+        wal = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+        store.attach_wal(wal)
+        _drive(store, batches)
+        wal.sync()
+        wal.close()
+        # a store from a DIFFERENT lineage: same schema, different
+        # dictionary content at the same positions
+        other = build_crash_store(False)
+        other.dicts.services.encode("not-from-this-log")
+        other.dicts.services.encode("nor-this")
+        wal2 = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+        with pytest.raises(WalReplayError, match="lineage"):
+            replay_into(other, wal2, from_seq=0)
+        wal2.close()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint slab integrity (rev 13)
+# ---------------------------------------------------------------------------
+
+
+class TestSlabIntegrity:
+    def _saved(self, tmp_path):
+        store = build_crash_store(False)
+        _drive(store, crash_batches(2))
+        path = str(tmp_path / "ckpt")
+        checkpoint.save(store, path)
+        return store, path
+
+    def test_corrupt_slab_fails_fast_with_named_error(self, tmp_path):
+        store, path = self._saved(tmp_path)
+        state_file = os.path.join(path, "state.npz")
+        data = dict(np.load(state_file))
+        key = sorted(k for k in data
+                     if data[k].size and data[k].dtype != bool)[0]
+        arr = data[key].copy()
+        flat = arr.reshape(-1)
+        flat[0] = flat[0] ^ 1 if np.issubdtype(
+            arr.dtype, np.integer) else flat[0] + 1.0
+        data[key] = arr
+        # rewrite a VALID npz with silently different content — the
+        # rot the zip layer cannot catch, only the manifest CRC can
+        from zipkin_tpu.checkpoint import _savez_fast
+
+        _savez_fast(state_file, data)
+        with pytest.raises(CorruptSlabError, match=key.split(".")[0]):
+            checkpoint.load(path)
+
+    def test_pre13_snapshot_without_crcs_still_loads(self, tmp_path):
+        store, path = self._saved(tmp_path)
+        meta_file = os.path.join(path, "meta.json")
+        import json
+
+        with open(meta_file) as f:
+            meta = json.load(f)
+        meta.pop("slab_crc32", None)
+        meta.pop("clocks", None)
+        meta["revision"] = 12
+        with open(meta_file, "w") as f:
+            json.dump(meta, f)
+        rec = checkpoint.load(path)
+        assert states_bitwise_equal(store.state, rec.state)
+
+
+# ---------------------------------------------------------------------------
+# Collector: ack-after-durable-append + quiesce ordering
+# ---------------------------------------------------------------------------
+
+
+class TestCollectorDurability:
+    def test_ingest_durable_acks_after_durable_append(self, tmp_path):
+        from zipkin_tpu.ingest.collector import Collector
+
+        store = build_crash_store(False)
+        wal = WriteAheadLog(str(tmp_path / "wal"), fsync="interval",
+                            interval_s=0.01)
+        store.attach_wal(wal)
+        col = Collector(store)
+        spans = crash_batches(1)[0]
+        stored = col.ingest_durable(spans)
+        assert stored == len(spans)
+        # the ack barrier held: everything appended is fsynced
+        assert wal.durable_seq == wal.last_seq >= 1
+        tids = sorted({s.trace_id for s in spans})[:2]
+        assert any(store.get_spans_by_trace_ids(tids))
+        col.close()
+        wal.close()
+
+    def test_durable_entry_pushes_back_instead_of_false_ack(self):
+        from zipkin_tpu.ingest.collector import Collector
+        from zipkin_tpu.ingest.receiver import ResultCode, ScribeReceiver
+        from zipkin_tpu.wal.log import WalDurabilityError
+
+        store = build_crash_store(False)
+
+        # a WAL whose durable frontier never advances (dead fsync)
+        class _NeverDurable:
+            last_seq = 0
+
+            def append(self, payload):
+                self.last_seq += 1
+                return self.last_seq
+
+            def wait_durable(self, seq, timeout=None):
+                return False
+
+        store.attach_wal(_NeverDurable())
+        col = Collector(store)
+        spans = crash_batches(1)[0][:4]
+        with pytest.raises(WalDurabilityError):
+            col.ingest_durable(spans)
+        # and on the wire that is TRY_LATER (retry), never OK
+        rx = ScribeReceiver(col.ingest_durable)
+        import base64
+
+        from zipkin_tpu.wire.thrift import span_to_bytes
+
+        entries = [("zipkin",
+                    base64.b64encode(span_to_bytes(s)).decode())
+                   for s in spans]
+        assert rx.log(entries) == ResultCode.TRY_LATER
+        assert rx.stats["pushed_back"] == 1
+        store.wal = None
+        col.close()
+
+    def test_flush_quiesces_in_durability_order(self, tmp_path):
+        from zipkin_tpu.ingest.collector import Collector
+
+        store = build_crash_store(False)
+        calls = []
+        store.drain_pipeline = lambda: calls.append("drain")
+        store.seal_barrier = lambda: calls.append("seal")
+        store.wal_sync = lambda: calls.append("fsync")
+        col = Collector(store)
+        col.flush()
+        order = [c for c in calls]
+        assert "drain" in order and "seal" in order and "fsync" in order
+        assert (order.index("drain") < order.index("seal")
+                < order.index("fsync"))
+        # close() runs the same quiesce before store.close()
+        calls.clear()
+        store.close = lambda: calls.append("close")
+        col.close()
+        assert calls.index("fsync") < calls.index("close")

@@ -25,6 +25,7 @@ from zipkin_tpu.columnar.dictionary import DictionarySet
 from zipkin_tpu.columnar.encode import SpanCodec
 from zipkin_tpu.store import device as dev
 from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.testing.crash import kill_point
 
 _STATE_FILE = "state.npz"
 _META_FILE = "meta.json"
@@ -61,34 +62,70 @@ _PINS_FILE = "pins.pkl"
 #    store and re-aligns the capture clocks with one capture_now()
 #    flush. Snapshots without the key restore plain stores unchanged,
 #    and pre-12 loaders simply ignore the extra files.
-_REVISION = 12
+# 13: durability (zipkin_tpu.wal): single-device snapshots add
+#    meta["clocks"] — the host pacing mirrors (write/capture/sweep/
+#    archive clocks, sealed frontier) plus the last-applied WAL
+#    sequence — making restore EXACT instead of re-seeded ("just
+#    rotated" / capture_now flush), which is what lets WAL replay
+#    land a bitwise-identical state; and meta["slab_crc32"] — a CRC32
+#    per state leaf, verified on restore (CorruptSlabError) so a
+#    rotted slab fails fast instead of feeding garbage into
+#    device_put. Pre-13 snapshots restore exactly as before (clocks
+#    re-seeded, no CRC check); pre-13 loaders ignore both keys.
+_REVISION = 13
 _SEGMENTS_DIR = "segments"
 
 
+class CorruptSlabError(RuntimeError):
+    """A checkpoint state slab failed its manifest CRC32 — the
+    snapshot is damaged (torn copy, disk rot, or mixed cuts). Restore
+    refuses to feed the corrupt leaf to the device; recover from the
+    ``.old`` snapshot or an earlier checkpoint plus the WAL."""
+
+
+def _slab_crc(arr) -> int:
+    """CRC32 over a leaf's raw C-order bytes (dtype/shape are pinned
+    by the npy header, so content bytes are the integrity surface)."""
+    import zlib
+
+    a = np.ascontiguousarray(arr)
+    return zlib.crc32(memoryview(a).cast("B"))
+
+
+def _host_clocks(store) -> Optional[dict]:
+    """The single-device store's host pacing clocks, captured under
+    the same read lock as the state gather (the mirrors advance inside
+    the commit's write-lock hold, so this pair is exact)."""
+    if not hasattr(store, "_cap_upto"):
+        return None
+    return {
+        "wp": int(store._wp),
+        "awp": int(store._awp),
+        "bwp": int(store._bwp),
+        "archived": int(store._archived),
+        "batches_since_sweep": int(store._batches_since_sweep),
+        "cap_upto": int(store._cap_upto),
+        "cap_a": int(store._cap_a),
+        "cap_b": int(store._cap_b),
+        "sealed_upto": int(store._sealed_upto),
+        "wal_applied": int(getattr(store, "_wal_applied", 0)),
+    }
+
+
 def _dict_dump(d) -> list:
-    out = []
-    for v in d.values():
-        if isinstance(v, bytes):
-            out.append({"b": v.hex()})
-        elif isinstance(v, tuple):
-            out.append({"t": list(v)})
-        elif v is None:
-            out.append({"n": None})
-        else:
-            out.append({"s": v})
-    return out
+    # One entry codec shared with the WAL's dictionary deltas
+    # (wal/record.py): replay equality-verifies restored entries
+    # against delta values, so the two must never diverge.
+    from zipkin_tpu.wal.record import dump_value
+
+    return [dump_value(v) for v in d.values()]
 
 
 def _dict_load(dictionary, values: list) -> None:
+    from zipkin_tpu.wal.record import load_value
+
     for item in values:
-        if "b" in item:
-            dictionary.encode(bytes.fromhex(item["b"]))
-        elif "t" in item:
-            dictionary.encode(tuple(item["t"]))
-        elif "n" in item:
-            dictionary.encode(None)
-        else:
-            dictionary.encode(item["s"])
+        dictionary.encode(load_value(item))
 
 
 def _savez_fast(path: str, leaves: dict) -> None:
@@ -286,6 +323,11 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
             # write blocks on the write lock until the gather is done,
             # so the rows are still resident in the gathered state).
             _seal_barrier(store)
+            # Host clocks under the SAME read lock as the gather: the
+            # mirrors advance inside the commit's write-lock hold, so
+            # (state, clocks, applied WAL seq) is one consistent cut —
+            # the anchor deterministic replay resumes from.
+            clocks = None if n_shards else _host_clocks(store)
             state = store.states if n_shards else store.state
             host_state = jax.device_get(state)
         for name in dev.StoreState._FIELDS:
@@ -306,6 +348,7 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
         try:
             with store._rw.read():
                 _seal_barrier(store)  # same argument as the fast path
+                clocks = None if n_shards else _host_clocks(store)
                 gen = _state_generation(store, n_shards,
                                         chunk_deadline_s)
                 if os.path.isdir(staging):
@@ -402,6 +445,10 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
         "revision": _REVISION,
         "config": store.config._asdict(),
         "shards": n_shards,
+        # Per-slab integrity: verified on restore (CorruptSlabError).
+        # For staged leaves this re-reads the .npy files (host IO only,
+        # never device time under a lock).
+        "slab_crc32": {k: _slab_crc(v) for k, v in leaves.items()},
         "ttls": ttls_snapshot,
         "name_lc": {str(k): v for k, v in store._name_lc.items()},
         "dicts": {
@@ -415,6 +462,8 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
     }
     if archive_meta is not None:
         meta["archive"] = archive_meta
+    if clocks is not None:
+        meta["clocks"] = clocks
     parent = os.path.dirname(os.path.abspath(path)) or "."
     tmp = tempfile.mkdtemp(prefix=".ckpt-", dir=parent)
     old = path + ".old"
@@ -466,6 +515,11 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
         shutil.rmtree(old, ignore_errors=True)
         if os.path.isdir(path):
             os.replace(path, old)
+        # Crash-harness injection site (testing/crash.py): dying HERE
+        # is the worst mid-swap moment — only ``path.old`` (or nothing,
+        # on the first save) is restorable, and the WAL was not yet
+        # truncated, so recovery must fall back + replay.
+        kill_point("mid-checkpoint")
         os.replace(tmp, path)
         shutil.rmtree(old, ignore_errors=True)
         # The staged cut is fully inside the finalized snapshot now.
@@ -474,6 +528,15 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    # Checkpoint-coordinated WAL truncation: the finalized snapshot
+    # (which includes the sealed cold-tier frontier — the seal barrier
+    # ran under the gather's read lock) covers every record up to its
+    # applied sequence, so those segments can go. Runs ONLY after the
+    # rename landed — a failed save never shrinks the log.
+    wal = getattr(store, "wal", None)
+    if wal is not None and clocks is not None:
+        stats["wal_truncated_segments"] = wal.truncate(
+            int(clocks["wal_applied"]))
     return stats
 
 
@@ -501,6 +564,17 @@ def _segment_blob_matches(blob_path: str, seg) -> bool:
         and header.get("n_spans") == seg.n_spans
         and header.get("comp_bytes") == seg.comp_bytes
     )
+
+
+def exists(path) -> bool:
+    """True when ``load(path)`` has a snapshot to restore — the
+    directory itself, or the ``.old`` fallback a crash mid-swap leaves
+    behind. The ONE restorability predicate (example.py's boot and
+    wal/recovery.recover share it): a boot path that only checked
+    ``path`` would build a FRESH store after a mid-swap crash and
+    replay the WAL tail against empty dictionaries."""
+    return bool(path) and (os.path.isdir(path)
+                           or os.path.isdir(path + ".old"))
 
 
 def load(path: str, mesh=None):
@@ -578,6 +652,23 @@ def load(path: str, mesh=None):
                 store.pins.pin(int(tid), bank)
 
     data = np.load(os.path.join(path, _STATE_FILE))
+    # Slab integrity (revision 13): every leaf checks against its
+    # manifest CRC32 BEFORE anything reaches device_put — a rotted
+    # slab is a named, immediate failure, not device garbage. Pre-13
+    # snapshots carry no CRCs and skip the check.
+    crcs = meta.get("slab_crc32") or {}
+
+    def _leaf(key):
+        arr = np.asarray(data[key])
+        want = crcs.get(key)
+        if want is not None and _slab_crc(arr) != int(want):
+            raise CorruptSlabError(
+                f"checkpoint slab '{key}' fails its manifest CRC32 — "
+                f"snapshot at {path} is damaged; restore from the "
+                f".old snapshot or an earlier checkpoint + WAL replay"
+            )
+        return arr
+
     upd = {}
     # Counters the snapshot predates keep their init defaults — the
     # schema may grow counters (e.g. key_claim_drops) and ingest
@@ -586,9 +677,10 @@ def load(path: str, mesh=None):
     counters = dict(base_state.counters)
     for key in data.files:
         if key.startswith("counters."):
-            counters[key.split(".", 1)[1]] = jax.numpy.asarray(data[key])
+            counters[key.split(".", 1)[1]] = jax.numpy.asarray(
+                _leaf(key))
         else:
-            upd[key] = jax.numpy.asarray(data[key])
+            upd[key] = jax.numpy.asarray(_leaf(key))
     # Drop snapshot counters the current schema no longer carries.
     counters = {
         k: v for k, v in counters.items() if k in base_state.counters
@@ -756,16 +848,33 @@ def load(path: str, mesh=None):
             # The pre-rev-4 schema had no span table: re-insert resident
             # spans so post-restore children still find their parents.
             store.state = dev.rebuild_span_tab(store.state)
-    # Re-seed the host mirrors that pace dependency bucket rotation.
+    # Re-seed the host mirrors that pace dependency bucket rotation —
+    # or, for revision-13 snapshots, restore them EXACTLY: the saved
+    # clocks were captured under the gather's read lock, so sweep and
+    # bucket-rotation cadence resume mid-stride and a WAL replay
+    # re-cuts the uncrashed drive's launches bitwise (wal/recovery).
     store._wp = int(store.state.write_pos)
     store._archived = store._wp
+    clocks = meta.get("clocks")
+    if clocks:
+        store._archived = int(clocks["archived"])
+        store._batches_since_sweep = int(clocks["batches_since_sweep"])
+        store._awp = int(clocks["awp"])
+        store._bwp = int(clocks["bwp"])
+        store._cap_upto = int(clocks["cap_upto"])
+        store._cap_a = int(clocks["cap_a"])
+        store._cap_b = int(clocks["cap_b"])
+        store._sealed_upto = int(clocks["sealed_upto"])
+        store._wal_applied = int(clocks.get("wal_applied", 0))
     arch = meta.get("archive")
     if arch:
-        return _restore_tiered(path, store, arch)
+        return _restore_tiered(path, store, arch,
+                               exact_clocks=bool(clocks))
     return store
 
 
-def _restore_tiered(path: str, store, arch: dict):
+def _restore_tiered(path: str, store, arch: dict,
+                    exact_clocks: bool = False):
     """Rebuild the TieredSpanStore around a restored device store:
     segments load from their immutable blobs, the captured-gid
     watermark restores from the manifest, and one capture_now() flush
@@ -773,7 +882,12 @@ def _restore_tiered(path: str, store, arch: dict):
     mirrors don't survive a restart — flushing the resident uncaptured
     window to a fresh segment makes every clock zero-delta again; the
     row overlap with the ring is the tiers' normal state and gid-level
-    dedupe absorbs it)."""
+    dedupe absorbs it).
+
+    ``exact_clocks`` (revision-13 snapshots): the capture clocks were
+    saved exactly, so the reseed + flush is SKIPPED — capture resumes
+    mid-stride, which keeps a WAL replay's capture windows (and hence
+    its cold segments) identical to the uncrashed drive's."""
     from zipkin_tpu.store.archive import (
         ArchiveParams,
         Segment,
@@ -807,6 +921,8 @@ def _restore_tiered(path: str, store, arch: dict):
     directory.restore(
         segs, max((s.seg_id for s in segs), default=-1) + 1)
     tiered = TieredSpanStore(store, params=params, directory=directory)
+    if exact_clocks:
+        return tiered
     # The save-time manifest may ship a segment sealed just past its
     # captured_upto clock read (harmless superset, see save()); adopt
     # the segments' CONTIGUOUS frontier so the capture_now flush below

@@ -165,6 +165,52 @@ class Collector:
             else list(payload)
         self.queue.add(_ThriftPayload(segments))
 
+    # -- durable (ack-after-append) entries -----------------------------
+    #
+    # With a write-ahead log attached to the store, a receiver that
+    # promises durability on ack (scribe returning OK, a kafka client
+    # committing offsets after ``process`` returns) must not ack from
+    # the async queue — an accepted-but-unprocessed batch would be
+    # acked yet absent from the log at a crash. These entries run the
+    # same decode + sample + store path SYNCHRONOUSLY on the calling
+    # thread (the store's write path journals before committing) and
+    # then block on the WAL's durable frontier: under the group-commit
+    # fsync policy, concurrent ackers share one fsync per commit
+    # window. Wire them as the receiver's ``process``/
+    # ``process_thrift`` callables (main/example.py does when
+    # --wal-dir is set); see docs/DURABILITY.md.
+
+    def ingest_durable(self, spans: Sequence[Span]) -> int:
+        """Synchronous span ingest + durable-append barrier; returns
+        the stored count. Drop-in ``process`` target for receivers."""
+        stored = self._write_spans(list(spans))
+        self._wal_barrier()
+        return stored
+
+    def ingest_thrift_durable(self, payload) -> int:
+        """Synchronous raw-thrift ingest + durable-append barrier;
+        drop-in ``process_thrift`` target for receivers."""
+        segments = [payload] if isinstance(payload, (bytes, bytearray)) \
+            else list(payload)
+        stored = self._write_thrift(segments)
+        self._wal_barrier()
+        return stored
+
+    def _wal_barrier(self) -> None:
+        """Block until every record appended so far is fsynced (the
+        group-commit ack barrier). No-op without a WAL. Raises
+        WalDurabilityError when the frontier cannot be covered (fsync
+        failing, or the wait timed out) — the caller must NOT ack;
+        receivers map it to scribe TRY_LATER."""
+        wal = getattr(self.store, "wal", None)
+        if wal is not None:
+            from zipkin_tpu.wal.log import WalDurabilityError
+
+            if not wal.wait_durable(wal.last_seq):
+                raise WalDurabilityError(
+                    "timed out waiting for the WAL durable frontier; "
+                    "refusing to ack")
+
     def _fast_path_available(self) -> bool:
         if self._fast_ok is None:
             if getattr(self.store, "write_thrift", None) is None:
@@ -322,14 +368,35 @@ class Collector:
         if drain is not None:
             drain()
 
+    def _quiesce_store(self) -> None:
+        """Durability-ordered drain of the store's async machinery:
+        drain-pipeline → seal-barrier → WAL-fsync (docs/DURABILITY.md
+        shutdown ordering — each step's output is the next step's
+        input: committed units may pull capture windows, sealed
+        windows advance the frontier a checkpoint cuts at, and the
+        fsync makes every journaled record durable before any
+        checkpoint claims to cover it)."""
+        self._drain_store_pipeline()
+        barrier = getattr(self.store, "seal_barrier", None)
+        if barrier is not None:
+            barrier()
+        sync = getattr(self.store, "wal_sync", None)
+        if sync is not None:
+            sync()
+
     def flush(self) -> None:
+        """Drain everything accepted so far: queue workers, buffered
+        self-trace spans, the ingest pipeline, pending capture seals,
+        and the WAL (fsync) — after this, 'flushed' means visible to
+        reads AND durable in the log."""
         self.queue.join()
         self._flush_self_spans()
-        self._drain_store_pipeline()
+        self._quiesce_store()
 
     def close(self) -> None:
         self.queue.close()
         self._flush_self_spans()
+        self._quiesce_store()
         # store.close() stops the ingest pipeline (draining accepted
         # batches) and the capture sealer before returning.
         self.store.close()

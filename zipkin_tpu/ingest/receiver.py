@@ -15,6 +15,7 @@ import threading
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 from zipkin_tpu.ingest.queue import QueueFullException
+from zipkin_tpu.wal.log import WalDurabilityError
 from zipkin_tpu.models.span import (
     Annotation,
     AnnotationType,
@@ -89,7 +90,18 @@ class ScribeReceiver:
             return ResultCode.OK
         try:
             self.process(spans)
-        except QueueFullException:
+        except (QueueFullException, WalDurabilityError):
+            # Queue full and not-yet-durable are the same answer on
+            # the wire: don't ack, client retries (the ack-after-
+            # durable-append contract, docs/DURABILITY.md).
+            self._bump("pushed_back")
+            return ResultCode.TRY_LATER
+        except Exception:
+            # The durable entries run the whole store write path on
+            # this handler thread, so its exception surface (suspect
+            # store, closing store) lands here; any of it maps to
+            # TRY_LATER — a torn connection would read as a lost batch
+            # to clients that only retry on the wire code.
             self._bump("pushed_back")
             return ResultCode.TRY_LATER
         return ResultCode.OK
@@ -117,7 +129,13 @@ class ScribeReceiver:
             # isolate a thrift-corrupt entry instead of dropping the
             # whole batch.
             self.process_thrift(raws)
-        except QueueFullException:
+        except (QueueFullException, WalDurabilityError):
+            # See log(): not-yet-durable == backpressure on the wire.
+            self._bump("pushed_back")
+            return ResultCode.TRY_LATER
+        except Exception:
+            # See log(): any store-path failure is TRY_LATER, never a
+            # torn connection.
             self._bump("pushed_back")
             return ResultCode.TRY_LATER
         return ResultCode.OK

@@ -58,6 +58,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "unsealed eviction capture windows; a full "
                         "backlog is the only way capture can stall "
                         "ingest (0 = seal inline on the write path)")
+    p.add_argument("--wal-dir", default=None,
+                   help="write-ahead log dir: journal every ingest "
+                        "batch before commit, replay the tail at boot, "
+                        "and switch scribe/kafka receivers to "
+                        "ack-after-durable-append (single-device "
+                        "stores only; see docs/DURABILITY.md)")
+    p.add_argument("--wal-fsync", default="interval",
+                   choices=("batch", "interval", "off"),
+                   help="WAL fsync policy: per-batch, group-commit "
+                        "interval (default), or off (page-cache only)")
+    p.add_argument("--wal-fsync-interval", type=float, default=0.05,
+                   help="group-commit fsync cadence in seconds "
+                        "(--wal-fsync interval)")
+    p.add_argument("--wal-segment-bytes", type=int, default=64 << 20,
+                   help="roll WAL segment files at this size; whole "
+                        "segments are deleted once a checkpoint "
+                        "covers them")
     p.add_argument("--seed-traces", type=int, default=0,
                    help="generate N synthetic traces at startup")
     p.add_argument("--checkpoint", default=None,
@@ -85,13 +102,15 @@ def build_app(args):
         )
     store = None
     if args.checkpoint:
-        import os
-
         from zipkin_tpu import checkpoint
 
-        if os.path.isdir(args.checkpoint):
+        if checkpoint.exists(args.checkpoint):
             # A sharded snapshot restores a ShardedSpanStore (shard
             # count from the snapshot; must match --shards if given).
+            # exists() includes the .old mid-swap fallback — booting
+            # FRESH after a crashed save would replay the WAL tail
+            # against empty dictionaries (lineage error at best,
+            # silent loss of checkpoint-covered spans at worst).
             store = checkpoint.load(args.checkpoint)
             n = getattr(store, "n", 0)
             if args.shards and n != args.shards:
@@ -148,6 +167,30 @@ def build_app(args):
     hot = getattr(store, "hot", store)
     if hasattr(hot, "capture_backlog"):
         hot.capture_backlog = max(0, args.capture_backlog)
+    if args.wal_dir:
+        if not hasattr(hot, "attach_wal"):
+            raise SystemExit(
+                "--wal-dir requires the single-device store (the "
+                "sharded store's per-shard journal is not wired yet)"
+            )
+        from zipkin_tpu.wal import WriteAheadLog, replay_into
+
+        wal = WriteAheadLog(
+            args.wal_dir, fsync=args.wal_fsync,
+            interval_s=args.wal_fsync_interval,
+            segment_bytes=args.wal_segment_bytes,
+        )
+        # Boot-time recovery: the checkpoint (restored above, or a
+        # fresh store) is the base; every WAL record past its applied
+        # sequence replays through the normal ingest path — capture,
+        # sealing, and sweep cadence included — BEFORE the collector's
+        # pipeline starts and the ports open.
+        hot.attach_wal(wal)
+        stats = replay_into(store, wal)
+        if stats["replayed_records"]:
+            print(f"wal: replayed {stats['replayed_records']} records "
+                  f"({stats['replayed_spans']} spans) in "
+                  f"{stats['replay_s']}s")
     adaptive = (
         AdaptiveConfig(target_store_rate=args.adaptive_target)
         if args.adaptive_target > 0 else None
@@ -189,16 +232,28 @@ def main(argv=None) -> None:
         from zipkin_tpu.ingest.receiver import ScribeReceiver
         from zipkin_tpu.ingest.scribe_server import ScribeServer
 
-        scribe_srv = ScribeServer(
-            ScribeReceiver(collector.accept,
-                           process_thrift=collector.accept_thrift),
-            args.host, args.scribe_port,
-        )
+        # Ack contract: with a WAL, scribe's OK means "durably
+        # appended" — the receiver processes synchronously through the
+        # durable entries instead of acking from the async queue.
+        if getattr(store, "wal", None) is not None:
+            receiver = ScribeReceiver(
+                collector.ingest_durable,
+                process_thrift=collector.ingest_thrift_durable,
+            )
+        else:
+            receiver = ScribeReceiver(
+                collector.accept,
+                process_thrift=collector.accept_thrift,
+            )
+        scribe_srv = ScribeServer(receiver, args.host, args.scribe_port)
         scribe_srv.serve_in_thread()
     print(f"zipkin-tpu example serving on {args.host}:{args.port}"
           + (f" (scribe tcp :{args.scribe_port})" if scribe_srv else ""))
 
     stop = threading.Event()
+    # SIGINT and SIGTERM share the graceful-save path: both land in
+    # the ordered shutdown below (drain → seal → WAL-fsync →
+    # checkpoint) instead of an interpreter teardown mid-write.
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
 
@@ -218,11 +273,33 @@ def main(argv=None) -> None:
                 checkpoint_now()
                 last_ckpt = time.time()
     finally:
-        checkpoint_now()
+        # Graceful-save ordering (docs/DURABILITY.md): stop intake
+        # first, then drain-pipeline → seal-barrier → WAL-fsync
+        # (collector.flush enforces that order), THEN checkpoint — so
+        # the snapshot's sealed frontier and applied WAL sequence
+        # cover everything accepted, and its success truncates the
+        # covered log segments. close() comes last.
         if scribe_srv is not None:
             scribe_srv.shutdown()
         server.shutdown()
+        try:
+            collector.flush()
+        except Exception:
+            pass  # a failed drain must not block the checkpoint
+        try:
+            checkpoint_now()
+        except Exception:
+            # A failed final save (disk full, suspect store) must not
+            # skip the drain/fsync below: the WAL still covers what
+            # the snapshot was meant to, so close() losing its final
+            # fsync would be the only way to actually lose data here.
+            import traceback
+
+            traceback.print_exc()
         collector.close()
+        wal = getattr(store, "wal", None)
+        if wal is not None:
+            wal.close()
 
 
 if __name__ == "__main__":

@@ -156,6 +156,19 @@ class TieredSpanStore(SpanStore):
     def seal_barrier(self) -> None:
         self.hot.seal_barrier()
 
+    # -- write-ahead log passthrough (the journal hook lives on the hot
+    # store's write path; capture/seal replays ride it) ----------------
+
+    @property
+    def wal(self):
+        return self.hot.wal
+
+    def attach_wal(self, wal) -> None:
+        self.hot.attach_wal(wal)
+
+    def wal_sync(self) -> None:
+        self.hot.wal_sync()
+
     # -- row reads ------------------------------------------------------
 
     def _segments(self):

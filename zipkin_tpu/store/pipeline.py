@@ -71,7 +71,11 @@ class IngestUnit(NamedTuple):
     """One committed launch's worth of work: a padded DeviceBatch
     (stacked along a leading axis when ``chained``) plus the host
     bookkeeping the commit stage needs. ``n_parts`` is the number of
-    chunker parts inside (the sweep-cadence increment)."""
+    chunker parts inside (the sweep-cadence increment). ``wal_seq``
+    is the unit's write-ahead-log sequence (None when no WAL is
+    attached); the commit stage advances the store's applied frontier
+    to it inside the same write-lock hold as the donating swap, so a
+    checkpoint cut is always consistent with its manifest sequence."""
 
     db: object
     n_spans: int
@@ -79,6 +83,7 @@ class IngestUnit(NamedTuple):
     n_banns: int
     n_parts: int
     chained: bool
+    wal_seq: Optional[int] = None
 
 
 class _StageBase:
@@ -360,6 +365,9 @@ class EvictionSealer(_StageBase):
             # the hole visible keeps a later checkpoint cut from
             # claiming a window the cold tier never got.
             return
+        from zipkin_tpu.testing.crash import kill_point
+
+        kill_point("mid-seal")
         sink(batch, gids, lo, hi,
              pull_s + (time.perf_counter() - t0))
         self._store._note_sealed(lo, hi)

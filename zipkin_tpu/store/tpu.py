@@ -42,6 +42,7 @@ from zipkin_tpu.store.pipeline import (
 )
 from zipkin_tpu.columnar.encode import to_signed64
 from zipkin_tpu.concurrency import RWLock
+from zipkin_tpu.testing.crash import kill_point
 from zipkin_tpu.store.base import (
     IndexedTraceId,
     PinBank,
@@ -351,6 +352,17 @@ class TpuSpanStore(SpanStore):
         # path (the _wp/_awp/_bwp mirrors, capture/archive triggers,
         # sweep cadence).
         self._pipeline: Optional[IngestPipeline] = None
+        # Durable write-ahead log (zipkin_tpu.wal): when attached, every
+        # planned launch group is journaled (stage-1 output + dictionary
+        # delta) BEFORE its donating commit; _wal_applied tracks the
+        # highest sequence whose unit has committed to the device
+        # (advanced inside the commit's write-lock hold, so checkpoint
+        # cuts read a sequence exactly consistent with the state), and
+        # _wal_marks the dictionary high-water sizes of the last
+        # journaled record (the next record's delta base).
+        self.wal = None
+        self._wal_applied = 0
+        self._wal_marks = None
         # Pending-sweep pacing: sweep every SWEEP_EVERY batches on the
         # write path (bounds how long a cross-batch child waits for its
         # link) and lazily before dependency reads — but only when
@@ -508,10 +520,16 @@ class TpuSpanStore(SpanStore):
     def _feed_units(self, pipe: IngestPipeline, parts) -> float:
         """Pad + enqueue one flushed part list as launch units; returns
         seconds spent blocked on pipeline backpressure (excluded from
-        the encode sketch)."""
+        the encode sketch). With a WAL attached each group is journaled
+        HERE — on the stage-1 caller thread, under the encode lock, so
+        append order equals feed order equals (FIFO) commit order."""
         stalled = 0.0
         for group in self._plan_units(parts):
-            stalled += pipe.feed(self._pad_unit(group))
+            unit = self._pad_unit(group)
+            if self.wal is not None:
+                unit = unit._replace(wal_seq=self._journal_group(group))
+                kill_point("after-append")
+            stalled += pipe.feed(unit)
         return stalled
 
     def _chunk_by_trace(self, spans: Sequence[Span]):
@@ -738,10 +756,21 @@ class TpuSpanStore(SpanStore):
         (NOTES_r03 §3 cost model; the ItemQueue batch-drain role,
         ItemQueue.scala:39)."""
         for group in self._plan_units(parts):
-            if len(group) == 1:
-                self._write_device(*group[0])
-            else:
-                self._write_device_many(group)
+            self._commit_group(group)
+
+    def _commit_group(self, group) -> None:
+        """Journal (when a WAL is attached) then commit one planned
+        launch group — the serial write path's ack-after-append point:
+        by the time the donating swap runs, the group's record is in
+        the log, so a crash between append and commit REPLAYS the
+        group instead of losing it."""
+        unit = self._pad_unit(group)
+        if self.wal is not None:
+            kill_point("before-append")
+            unit = unit._replace(wal_seq=self._journal_group(group))
+            kill_point("after-append")
+        self._commit_unit(unit)
+        kill_point("after-commit")
 
     def _plan_units(self, parts):
         """CHAIN_SIZES greedy grouping of chunker parts into launch
@@ -827,16 +856,30 @@ class TpuSpanStore(SpanStore):
         self._maybe_capture(unit.n_spans, unit.n_anns, unit.n_banns)
         self._maybe_archive(unit.n_spans)
         step = dev.ingest_steps if unit.chained else dev.ingest_step
+        # The host mirrors, the WAL applied frontier, and the cadence
+        # sweep all advance INSIDE the write-lock hold: a checkpoint's
+        # state gather (under the read lock) then always pairs the
+        # device cut with exactly-matching clocks — the invariant
+        # deterministic replay (wal/recovery) rebuilds launches from.
         with self._rw.write():
             self.state = step(self.state, unit.db)
-        self._wp += unit.n_spans
-        self._awp += unit.n_anns
-        self._bwp += unit.n_banns
-        self._step_seq += 1
-        self._observe_ingest(t0)
-        self._batches_since_sweep += unit.n_parts
-        if self._batches_since_sweep >= self.SWEEP_EVERY:
-            self._sweep_pending()
+            self._wp += unit.n_spans
+            self._awp += unit.n_anns
+            self._bwp += unit.n_banns
+            self._step_seq += 1
+            if unit.wal_seq is not None:
+                self._wal_applied = unit.wal_seq
+            # Dispatch accounting stops HERE: the cadence sweep below
+            # is its own launch, and folding it into the per-batch
+            # dispatch sketch would plant a 1-in-64 outlier that reads
+            # as an ingest regression.
+            dispatch_s = _time.perf_counter() - t0
+            self._batches_since_sweep += unit.n_parts
+            if self._batches_since_sweep >= self.SWEEP_EVERY:
+                self.state = dev.dep_sweep(self.state)
+                self._step_seq += 1
+                self._batches_since_sweep = 0
+        self._observe_ingest(t0, dispatch_s)
 
     def _write_device_many(self, group) -> None:
         """One chained launch over ≥2 chunks: pad every chunk to the
@@ -844,22 +887,26 @@ class TpuSpanStore(SpanStore):
         chunk individually satisfies the ring-capacity guards, and scan
         steps run sequentially, so per-launch invariants match the
         single-chunk path's."""
-        self._commit_unit(self._pad_unit(group))
+        self._commit_group(group)
 
     def _write_device(self, batch: SpanBatch, name_lc: np.ndarray,
                       indexable: np.ndarray) -> None:
         """Pad, upload, and run the fused ingest step for one chunk that
         already fits the ring capacities."""
-        self._commit_unit(self._pad_unit([(batch, name_lc, indexable)]))
+        self._commit_group([(batch, name_lc, indexable)])
 
-    def _observe_ingest(self, t0: float) -> None:
-        """Launch accounting: always-on dispatch time, plus the TRUE
-        step latency every INGEST_SYNC_EVERY-th launch (block on the
-        write_pos scalar — one tiny D2H, no ring traffic). The old
-        single-sketch scheme timed only the async dispatch, so
-        /metrics showed host dispatch cost as if it were device
-        compute (the r9 underreporting fix)."""
-        self._h_dispatch.observe(_time.perf_counter() - t0)
+    def _observe_ingest(self, t0: float,
+                        dispatch_s: Optional[float] = None) -> None:
+        """Launch accounting: always-on dispatch time (``dispatch_s``
+        when the caller clocked it before extra launches joined the
+        window), plus the TRUE step latency every INGEST_SYNC_EVERY-th
+        launch (block on the write_pos scalar — one tiny D2H, no ring
+        traffic). The old single-sketch scheme timed only the async
+        dispatch, so /metrics showed host dispatch cost as if it were
+        device compute (the r9 underreporting fix)."""
+        self._h_dispatch.observe(
+            dispatch_s if dispatch_s is not None
+            else _time.perf_counter() - t0)
         self._c_launches.inc()
         self._launch_seq += 1
         if self._launch_seq % self.INGEST_SYNC_EVERY == 1 \
@@ -877,12 +924,14 @@ class TpuSpanStore(SpanStore):
     SWEEP_EVERY = 64
 
     def _sweep_pending(self) -> None:
-        """Resolve pending (late-parent) children now; see dev.dep_sweep."""
+        """Resolve pending (late-parent) children now; see dev.dep_sweep.
+        Clock reset rides the write-lock hold (checkpoint-cut
+        consistency, see _commit_unit)."""
         self.ensure_writable()
         with self._rw.write():
             self.state = dev.dep_sweep(self.state)
-        self._step_seq += 1
-        self._batches_since_sweep = 0
+            self._step_seq += 1
+            self._batches_since_sweep = 0
 
     def _maybe_archive(self, incoming: int) -> None:
         """Close the current dependency time bucket on a span-volume
@@ -896,11 +945,12 @@ class TpuSpanStore(SpanStore):
         self.ensure_writable()
         with self._rw.write():
             self.state = dev.dep_close_bucket(self.state)
-        self._step_seq += 1
-        self._batches_since_sweep = 0
-        self._archived = min(
-            self._wp, max(self._wp + incoming - cap, self._wp - cap // 2)
-        )
+            self._step_seq += 1
+            self._batches_since_sweep = 0
+            self._archived = min(
+                self._wp,
+                max(self._wp + incoming - cap, self._wp - cap // 2),
+            )
 
     def _maybe_capture(self, n_s: int, n_a: int, n_b: int) -> None:
         """Eviction capture trigger, called BEFORE every device write
@@ -960,6 +1010,7 @@ class TpuSpanStore(SpanStore):
         else:
             batch, gids = mats_to_batch(
                 n_s, n_a, n_b, *jax.device_get((s_m, a_m, b_m)))
+            kill_point("mid-seal")
             self.eviction_sink(batch, gids, lo, hi,
                                _time.perf_counter() - t0)
             self._note_sealed(lo, hi)
@@ -1066,6 +1117,43 @@ class TpuSpanStore(SpanStore):
         self._cap_a = self._cap_b = 0
         self._sealed_upto = self._cap_upto
 
+    # -- durable write-ahead log (zipkin_tpu.wal) -----------------------
+
+    def attach_wal(self, wal) -> None:
+        """Journal every subsequent launch group into ``wal`` before
+        its donating commit (the ack-after-append contract,
+        docs/DURABILITY.md). Attach before live writes — groups
+        committed earlier are only covered by checkpoints. The store
+        does not own the log's lifecycle: callers close() it after the
+        store is closed."""
+        from zipkin_tpu.wal.record import dict_sizes
+
+        with self._lock:
+            self.wal = wal
+            self._wal_marks = dict_sizes(self.dicts)
+
+    def _journal_group(self, group) -> int:
+        """Append one planned launch group (+ the dictionary entries
+        its encode step added) to the WAL; returns the record's
+        sequence. Runs on the encoding thread under self._lock, so
+        append order == encode order == commit order — the property
+        replay's dictionary-delta chain depends on."""
+        from zipkin_tpu.wal.record import dump_dict_deltas, encode_unit
+
+        sizes, deltas = dump_dict_deltas(self.dicts, self._wal_marks)
+        seq = self.wal.append(encode_unit(group, self._wal_marks,
+                                          deltas))
+        self._wal_marks = sizes
+        return seq
+
+    def wal_sync(self) -> None:
+        """Force the attached WAL's durable frontier to the append
+        frontier (fsync); no-op without a WAL. Part of the shutdown
+        ordering: drain-pipeline → seal-barrier → wal_sync →
+        checkpoint."""
+        if self.wal is not None:
+            self.wal.sync()
+
     # -- pipelined ingest lifecycle (store/pipeline) --------------------
 
     def start_pipeline(self, depth: Optional[int] = None
@@ -1127,12 +1215,15 @@ class TpuSpanStore(SpanStore):
 
     def close(self) -> None:
         """Stop the pipeline (draining accepted batches) and the
-        capture sealer (sealing pulled windows) — nothing accepted or
-        captured is dropped on an orderly shutdown."""
+        capture sealer (sealing pulled windows), then force the WAL
+        durable — nothing accepted or captured is dropped on an
+        orderly shutdown. The WAL object itself stays open (its owner
+        closes it, after any final checkpoint truncation)."""
         self.stop_pipeline(raise_errors=False)
         s, self._sealer = self._sealer, None
         if s is not None:
             s.stop()
+        self.wal_sync()
 
     # TTLs above the per-write default mark a trace pinned: its spans are
     # materialized to the host pin bank so ring eviction can't drop them.

@@ -1,0 +1,318 @@
+"""Crash-injection harness: named kill points + a subprocess drive.
+
+The durability contract (docs/DURABILITY.md) is only testable by
+actually dying: a child process drives the normal ingest path with a
+WAL attached and ``SIGKILL``s ITSELF at a named point mid-write; the
+parent then recovers from what survived on disk and compares the
+result bitwise against an uncrashed oracle drive of the same batches.
+This is the FakeCassandra/minicluster move (SURVEY §4) applied to
+durability: real process death, no mocked fsync.
+
+Kill points (activated via ``ZIPKIN_CRASH_POINT=<name>[:N]`` — fire on
+the Nth hit, default the 1st; SIGKILL, so no atexit/finally runs):
+
+- ``before-append``   just before a launch group's WAL append — the
+  batch must be absent in full after recovery.
+- ``after-append``    between the durable append and the donating
+  device commit — replay must re-apply the batch.
+- ``after-commit``    after the device commit, before the ack returns —
+  the batch is present though never acked (durability is one-way).
+- ``mid-seal``        between an eviction-capture pull and the cold
+  segment append — replay must re-capture and re-seal.
+- ``mid-checkpoint``  between checkpoint.save's two renames — load
+  must fall back to ``.old`` (or a fresh store) + WAL replay.
+- ``mid-truncate``    between per-segment deletes of a checkpoint's
+  WAL truncation — the surviving suffix must still recover.
+
+``kill_point`` compiles to a dict-miss-fast no-op when the env var is
+unset, so the production hooks cost one attribute load per call site.
+
+Child usage (the parent helper ``run_crash_child`` builds this):
+
+    ZIPKIN_CRASH_POINT=after-append \\
+    python -m zipkin_tpu.testing.crash WORKDIR --batches 10 --ckpt-at 5
+
+The child acks each batch only after ``wait_durable`` (fsync=batch by
+default) and journals progress to ``WORKDIR/acked.log`` (fsync'd), so
+the parent knows exactly which batches were durably acked. It asserts
+one WAL record per batch (exit 3 otherwise) — the invariant that lets
+the parent line the recovered record frontier up against a batch-
+granular oracle drive.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+# -- the kill switch (read once, at import, in the CHILD process) -------
+
+_spec = os.environ.get("ZIPKIN_CRASH_POINT")
+if _spec:
+    _name, _, _nth = _spec.partition(":")
+    _POINT: Optional[str] = _name
+    _NTH = int(_nth) if _nth else 1
+else:
+    _POINT, _NTH = None, 0
+_hits = 0
+
+KILL_POINTS = ("before-append", "after-append", "after-commit",
+               "mid-seal", "mid-checkpoint", "mid-truncate")
+
+
+def kill_point(name: str) -> None:
+    """Die here (SIGKILL — no cleanup, no flush) when this is the
+    activated point's Nth hit. No-op unless ZIPKIN_CRASH_POINT is set."""
+    global _hits
+    if _POINT is None or name != _POINT:
+        return
+    _hits += 1
+    if _hits >= _NTH:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+# -- shared drive fixtures (child AND parent oracle use these) ----------
+#
+# Geometry note: the serial config never evicts at the drive sizes the
+# tests use (WAL mechanics only); the tiered config's 2^8 ring laps
+# several times, so eviction capture and cold-tier sealing are on the
+# replayed path. Batches are sized so each apply plans exactly ONE
+# launch unit (<= CHAIN_SIZES[0] trace parts, well under the span/ann
+# budgets) — the child asserts it, see module docstring.
+
+_TRACES_PER_BATCH = 6
+
+
+def crash_config(tiered: bool):
+    from zipkin_tpu.store import device as dev
+
+    if tiered:
+        return dev.StoreConfig(
+            capacity=1 << 8, ann_capacity=1 << 10, bann_capacity=1 << 9,
+            max_services=32, max_span_names=64,
+            max_annotation_values=256, max_binary_keys=64,
+            cms_width=1 << 10, hll_p=6, quantile_buckets=256,
+        )
+    return dev.StoreConfig(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=128, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=512,
+    )
+
+
+def build_crash_store(tiered: bool):
+    """A fresh store at the harness geometry — the recovery factory
+    and the oracle builder (identical construction on both sides is
+    what makes the bitwise comparison meaningful)."""
+    from zipkin_tpu.store.tpu import TpuSpanStore
+
+    hot = TpuSpanStore(crash_config(tiered))
+    if not tiered:
+        return hot
+    from zipkin_tpu.store.archive import ArchiveParams, TieredSpanStore
+
+    return TieredSpanStore(hot, params=ArchiveParams.for_config(
+        hot.config, compact_fanin=2, small_span_limit=hot.config.capacity,
+        bloom_bits=1 << 12, cms_width=1 << 10, hll_p=6,
+    ))
+
+
+def crash_batches(n_batches: int, tiered: bool = False) -> List[list]:
+    """Deterministic batches (seeded rng): the child drives them, the
+    parent re-derives them for the oracle."""
+    import numpy as np
+
+    from zipkin_tpu.tracegen.gen import generate_traces
+
+    rng = np.random.default_rng(41 if tiered else 40)
+    traces = generate_traces(
+        n_traces=n_batches * _TRACES_PER_BATCH, max_depth=3,
+        rng=rng, n_services=8,
+    )
+    return [
+        [s for t in traces[i * _TRACES_PER_BATCH:
+                           (i + 1) * _TRACES_PER_BATCH] for s in t]
+        for i in range(n_batches)
+    ]
+
+
+def _paths(workdir: str) -> Tuple[str, str, str]:
+    return (os.path.join(workdir, "wal"),
+            os.path.join(workdir, "ckpt"),
+            os.path.join(workdir, "acked.log"))
+
+
+# -- child ---------------------------------------------------------------
+
+
+def _child_main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="zipkin_tpu.testing.crash")
+    ap.add_argument("workdir")
+    ap.add_argument("--batches", type=int, default=10)
+    ap.add_argument("--ckpt-at", default="",
+                    help="comma-separated 1-based batch counts after "
+                         "which to checkpoint")
+    ap.add_argument("--tiered", action="store_true")
+    ap.add_argument("--fsync", default="batch")
+    ap.add_argument("--segment-bytes", type=int, default=64 << 20)
+    args = ap.parse_args(argv)
+
+    from zipkin_tpu import checkpoint
+    from zipkin_tpu.wal import WriteAheadLog
+
+    os.makedirs(args.workdir, exist_ok=True)
+    wal_dir, ckpt_dir, acked_path = _paths(args.workdir)
+    ckpt_at = {int(x) for x in args.ckpt_at.split(",") if x}
+
+    store = build_crash_store(args.tiered)
+    hot = getattr(store, "hot", store)
+    wal = WriteAheadLog(wal_dir, fsync=args.fsync,
+                        segment_bytes=args.segment_bytes)
+    hot.attach_wal(wal)
+    batches = crash_batches(args.batches, tiered=args.tiered)
+
+    acked = open(acked_path, "a")
+    for i, batch in enumerate(batches):
+        store.apply(batch)
+        if wal.last_seq != i + 1:
+            print(f"batch {i} planned {wal.last_seq - i} launch units; "
+                  f"the harness requires exactly one — shrink the "
+                  f"batch geometry", file=sys.stderr)
+            return 3
+        wal.wait_durable(wal.last_seq)
+        # The ack: a receiver would return OK here. Journaled with its
+        # own fsync so the parent knows the durably-acked frontier.
+        acked.write(f"{i} {wal.last_seq}\n")
+        acked.flush()
+        os.fsync(acked.fileno())
+        if i + 1 in ckpt_at:
+            checkpoint.save(store, ckpt_dir)
+    # No kill fired (point unset, or set past the drive): exit clean.
+    wal.sync()
+    return 0
+
+
+# -- parent helpers (tests/test_crash.py) --------------------------------
+
+
+def run_crash_child(workdir: str, point: Optional[str] = None,
+                    hit: int = 1, batches: int = 10,
+                    ckpt_at: Sequence[int] = (), tiered: bool = False,
+                    fsync: str = "batch",
+                    segment_bytes: int = 64 << 20,
+                    timeout: float = 600.0):
+    """Spawn the child drive; returns the CompletedProcess. A fired
+    kill point shows up as ``returncode == -signal.SIGKILL``."""
+    env = dict(os.environ)
+    env.pop("ZIPKIN_CRASH_POINT", None)
+    if point is not None:
+        if point not in KILL_POINTS:
+            raise ValueError(f"unknown kill point {point!r}")
+        env["ZIPKIN_CRASH_POINT"] = f"{point}:{hit}"
+    cmd = [sys.executable, "-m", "zipkin_tpu.testing.crash", workdir,
+           "--batches", str(batches), "--fsync", fsync,
+           "--segment-bytes", str(segment_bytes)]
+    if ckpt_at:
+        cmd += ["--ckpt-at", ",".join(str(x) for x in ckpt_at)]
+    if tiered:
+        cmd.append("--tiered")
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def acked_batches(workdir: str) -> int:
+    """Number of batches the child durably acked before dying."""
+    path = _paths(workdir)[2]
+    if not os.path.exists(path):
+        return 0
+    n = 0
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                n = int(parts[0]) + 1
+    return n
+
+
+def recover_crashed(workdir: str, tiered: bool = False):
+    """Recover from whatever the dead child left on disk. Returns
+    (store, replay stats, wal)."""
+    from zipkin_tpu.wal import WriteAheadLog, recover
+
+    wal_dir, ckpt_dir, _ = _paths(workdir)
+    wal = WriteAheadLog(wal_dir, fsync="off")
+    store, stats = recover(
+        ckpt_dir, wal,
+        fresh_store=lambda: build_crash_store(tiered))
+    return store, stats, wal
+
+
+def states_bitwise_equal(a, b) -> bool:
+    import jax
+    import numpy as np
+
+    fa, _ = jax.tree_util.tree_flatten(jax.device_get(a))
+    fb, _ = jax.tree_util.tree_flatten(jax.device_get(b))
+    return len(fa) == len(fb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(fa, fb))
+
+
+def verify_recovery(workdir: str, total_batches: int,
+                    tiered: bool = False) -> dict:
+    """The acceptance check, shared by every kill-point test:
+
+    1. every durably-ACKED batch survived (applied >= acked);
+    2. the recovered state is BITWISE identical to an uncrashed
+       oracle that applied exactly the recovered batch prefix —
+       hot rings/arena/counters, and for tiered drives the cold
+       segment frontier and federated trace reads too;
+    3. the first un-applied batch is PROVABLY ABSENT (its trace ids
+       resolve to nothing), never partially applied.
+
+    Raises AssertionError with context on any violation."""
+    store, stats, wal = recover_crashed(workdir, tiered=tiered)
+    acked = acked_batches(workdir)
+    applied = stats["applied_seq"]
+    assert applied >= acked, (
+        f"durably-acked batch lost: acked {acked}, recovered only "
+        f"{applied} ({stats})")
+    assert applied <= total_batches
+
+    batches = crash_batches(total_batches, tiered=tiered)
+    oracle = build_crash_store(tiered)
+    for b in batches[:applied]:
+        oracle.apply(b)
+
+    hot, ohot = getattr(store, "hot", store), getattr(oracle, "hot", oracle)
+    assert states_bitwise_equal(ohot.state, hot.state), (
+        f"recovered hot state differs from the {applied}-batch oracle "
+        f"(acked {acked}, {stats})")
+    if tiered:
+        cold = sorted((s.gid_lo, s.gid_hi, s.n_spans)
+                      for s in store.archive.snapshot())
+        ocold = sorted((s.gid_lo, s.gid_hi, s.n_spans)
+                       for s in oracle.archive.snapshot())
+        assert cold == ocold, (
+            f"cold tier differs: {cold} vs oracle {ocold}")
+        for b in batches[:applied]:
+            tids = sorted({s.trace_id for s in b})[:3]
+            assert (store.get_spans_by_trace_ids(tids)
+                    == oracle.get_spans_by_trace_ids(tids))
+    if applied < total_batches:
+        missing = sorted({s.trace_id for s in batches[applied]})
+        got = store.get_spans_by_trace_ids(missing)
+        assert not any(got), (
+            f"un-acked batch {applied} partially applied: "
+            f"{sum(map(len, got))} spans present")
+    return {"acked": acked, "applied": applied, **stats}
+
+
+if __name__ == "__main__":
+    sys.exit(_child_main())

@@ -1,0 +1,37 @@
+"""Durable write-ahead log + crash recovery (docs/DURABILITY.md).
+
+The commit-log role the reference delegates to Cassandra, made
+explicit for a store whose truth lives in volatile HBM:
+
+- ``WriteAheadLog`` (wal/log.py) — segmented, CRC-framed, optionally
+  deflated append log with per-batch / group-commit / off fsync
+  policies and checkpoint-coordinated truncation;
+- ``wal/record.py`` — the unit record codec: stage-1 encoded launch
+  groups plus their dictionary deltas, so replay re-cuts bitwise
+  identical launches;
+- ``wal/recovery.py`` — checkpoint restore + deterministic tail
+  replay through the store's normal commit body.
+
+Ack contract: with a WAL attached, ``TpuSpanStore.apply`` /
+``write_thrift`` return only after the batch's launch units are
+APPENDED; receivers that promise durability (scribe OK, kafka offset
+commits) additionally wait on the durable frontier
+(``Collector.ingest_durable`` / ``WriteAheadLog.wait_durable``).
+"""
+
+from zipkin_tpu.wal.log import (
+    FsyncPolicy,
+    WalDurabilityError,
+    WriteAheadLog,
+)
+from zipkin_tpu.wal.record import WalReplayError
+from zipkin_tpu.wal.recovery import recover, replay_into
+
+__all__ = [
+    "FsyncPolicy",
+    "WalDurabilityError",
+    "WriteAheadLog",
+    "WalReplayError",
+    "recover",
+    "replay_into",
+]
