@@ -553,6 +553,147 @@ def bench_batched_queries(store, ks=(1, 4, 16, 64), reps: int = 5):
     return out
 
 
+def bench_query_engine(store, reps: int = 20, concurrency: int = 8):
+    """Resident query engine (r11 tentpole, query/engine.py): the
+    ~105-115 ms per-request dispatch floor every query family paid at
+    1B spans (BENCH_1B.json), attacked on three tiers. Measures, on
+    the live streamed store:
+
+    - sketch tier: quantiles / top-k / HLL / catalogs off the host
+      mirror — target p50 < 10 ms (acceptance gate; they are numpy
+      reads, so this also proves the mirror resync path after the
+      bench's adopt_state);
+    - index tier: trace-id reads through the standing executor under
+      ``concurrency`` concurrent callers — target p99 < 50 ms (one
+      launch + one D2H shared per micro-batch vs one per request);
+    - cache tier: repeat-read latency + bitwise hit==cold identity;
+    - zero steady-state recompiles across all of it (the resident
+      programs stay resident).
+
+    Sketch answers are cross-checked against the device read path on
+    every rep (0 mismatches required, like the memory-oracle gates)."""
+    import threading
+
+    from zipkin_tpu.query.engine import QueryEngine
+
+    _log("query-engine: starting")
+    engine = QueryEngine(store, registry=_obs().Registry())
+    state = store.state
+    end_ts = int(state.ts_max) + 1
+    S = store.config.max_services
+    rng = np.random.default_rng(11)
+    svcs = [f"svc-{i:04d}" for i in rng.integers(0, S, size=64)]
+    it = iter(range(10**9))
+
+    def next_svc():
+        return svcs[next(it) % len(svcs)]
+
+    engine.get_all_service_names()  # resync the mirror (one fetch)
+
+    # Cross-check first, UNTIMED: the device read path costs the very
+    # dispatch floor the sketch tier avoids, so it must never sit
+    # inside the measured round (the p50 < 10ms gate would otherwise
+    # be structurally unreachable on a device store).
+    mismatches = 0
+    for _ in range(reps):
+        s = next_svc()
+        if (engine.service_duration_quantiles(s, [0.5, 0.95, 0.99])
+                != store.service_duration_quantiles(s, [0.5, 0.95,
+                                                        0.99])):
+            mismatches += 1
+        if engine.top_annotations(s) != store.top_annotations(s):
+            mismatches += 1
+        if (engine.estimated_unique_traces()
+                != store.estimated_unique_traces()):
+            mismatches += 1
+
+    def sketch_round():
+        s = next_svc()
+        engine.service_duration_quantiles(s, [0.5, 0.95, 0.99])
+        engine.top_annotations(s)
+        engine.estimated_unique_traces()
+        engine.get_all_service_names()
+
+    out = {"sketch": _timeit(sketch_round, reps=reps)}
+    out["sketch"]["p50_ms"] = round(out["sketch"]["p50_ms"] / 4, 3)
+    out["sketch"]["p99_ms"] = round(out["sketch"]["p99_ms"] / 4, 3)
+
+    # Warm the multi-probe jit rows for every batch size the
+    # concurrent drive can produce (1..concurrency requests per
+    # micro-batch) plus the cache phase's fixed 8-query batch (its
+    # pad-8 shape is otherwise unwarmed when --smoke drops
+    # concurrency below 8): the p99 must measure dispatch, not
+    # compiles — compiles are gated separately at zero AFTER this.
+    for n in sorted(set(range(1, concurrency + 1)) | {8}):
+        engine.executor.run(
+            [("name", next_svc(), None, end_ts, 10)] * n)
+    compiles0 = dev_compile_count()  # ingest + resident query jits
+
+    # Index tier under concurrency: every caller's per-request latency
+    # while `concurrency` threads hammer the standing executor.
+    lat_ms: list = []
+    lock = threading.Lock()
+
+    def caller(n):
+        mine = []
+        for _ in range(reps):
+            q = [("name", next_svc(), None, end_ts, 10)]
+            t0 = time.perf_counter()
+            engine.executor.run(q)  # cache-bypassing resident path
+            mine.append((time.perf_counter() - t0) * 1e3)
+        with lock:
+            lat_ms.extend(mine)
+
+    threads = [threading.Thread(target=caller, args=(i,))
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out["index_concurrent"] = {**_pctl(lat_ms),
+                               "concurrency": concurrency}
+    ex = engine.executor
+    out["index_concurrent"]["launches_saved"] = ex.launches_saved
+    out["index_concurrent"]["max_batch"] = ex.max_batch
+
+    # Cache tier: cold vs hit, bitwise identity.
+    queries = [("name", f"svc-{i:04d}", None, end_ts, 10)
+               for i in range(8)]
+
+    def ids(rows):
+        return [[(i.trace_id, i.timestamp) for i in r] for r in rows]
+
+    cold = ids(engine.get_trace_ids_multi(queries))
+    out["cache_hit"] = _timeit(
+        lambda: engine.get_trace_ids_multi(queries), reps=reps)
+    hit_identical = ids(engine.get_trace_ids_multi(queries)) == cold
+    out["sketch_mismatches"] = mismatches
+    out["cache_hit_identical"] = bool(hit_identical)
+    out["steady_recompiles"] = dev_compile_count() - compiles0
+    out["meets_sketch_p50_target"] = out["sketch"]["p50_ms"] < 10.0
+    out["meets_index_p99_target"] = (
+        out["index_concurrent"]["p99_ms"] < 50.0)
+    _log(f"query-engine: sketch p50 {out['sketch']['p50_ms']:.2f}ms "
+         f"index-concurrent p99 "
+         f"{out['index_concurrent']['p99_ms']:.1f}ms "
+         f"cache-hit p50 {out['cache_hit']['p50_ms']:.2f}ms "
+         f"recompiles {out['steady_recompiles']} "
+         f"mismatches {mismatches}")
+    return out
+
+
+def _obs():
+    from zipkin_tpu import obs
+
+    return obs
+
+
+def dev_compile_count() -> int:
+    from zipkin_tpu.store import device as dev
+
+    return dev.compile_count() + dev.query_compile_count()
+
+
 def bench_exactness(store, n_queries: int = 24,
                     budget_s: float | None = None):
     """On-device index-vs-scan exactness (VERDICT r3 item 7): the same
@@ -1276,6 +1417,16 @@ def main():
             reps=3 if args.smoke else 5,
         )
         emit("stream+queries+batched")
+        # Resident query engine (r11 tentpole): sketch-tier p50 /
+        # concurrent index-tier p99 / cache-hit identity against the
+        # p50<10ms & p99<50ms acceptance targets, with sketch answers
+        # cross-checked against the device path on every rep.
+        detail["query_engine"] = _bounded(
+            lambda: bench_query_engine(
+                store, reps=8 if args.smoke else 20,
+                concurrency=4 if args.smoke else 8),
+            timeout_s=600, label="query-engine")
+        emit("stream+queries+batched+engine")
         detail["index_exactness"] = bench_exactness(
             store, n_queries=9 if args.smoke else 24,
             budget_s=None if args.smoke else args.exactness_budget,

@@ -421,6 +421,111 @@ def run_wal() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_query() -> dict:
+    """Resident-query-engine phase (r11 tentpole): the three-tier read
+    path (query/engine.py) proven structurally on every CI run:
+    (a) sketch-tier answers (catalogs, quantiles, top-k, HLL) are
+    IDENTICAL to the device read path's while costing zero device
+    round-trips — p50 is gated in single-digit ms even on CPU;
+    (b) the steady-state query loop performs ZERO jit recompiles (the
+    resident programs stay resident); (c) a cache hit returns answers
+    bitwise-equal to the cold computation, and an ingest commit
+    invalidates precisely (the frontier-keyed re-answer matches a
+    fresh store read). Index-tier latency is trend data on CPU (the
+    ~110 ms dispatch floor this engine kills is a device-class
+    property), but its p99 rides the JSON for the TPU bench to gate."""
+    from zipkin_tpu import obs
+    from zipkin_tpu.query.engine import QueryEngine
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    config = dev.StoreConfig(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=128, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=512,
+    )
+    traces = generate_traces(n_traces=1200, max_depth=3, n_services=16)
+    spans = [s for t in traces for s in t][:3000]
+    store = TpuSpanStore(config)
+    for i in range(0, len(spans), 128):
+        store.apply(spans[i:i + 128])
+    reg = obs.Registry()
+    engine = QueryEngine(store, window_s=0.0, registry=reg)
+    svcs = sorted(store.get_all_service_names())
+    qs = [0.5, 0.95, 0.99]
+
+    # Sketch-tier identity: every answer bitwise-equals the device
+    # read path's (the conformance half of the sketch-tier contract).
+    ident = engine.get_all_service_names() == store.get_all_service_names()
+    for s in svcs:
+        ident = ident and (
+            engine.get_span_names(s) == store.get_span_names(s)
+            and engine.service_duration_quantiles(s, qs)
+            == store.service_duration_quantiles(s, qs)
+            and engine.top_annotations(s) == store.top_annotations(s)
+            and engine.top_binary_keys(s) == store.top_binary_keys(s)
+        )
+    ident = ident and (engine.estimated_unique_traces()
+                       == store.estimated_unique_traces())
+
+    end_ts = max(s.last_timestamp for s in spans if s.last_timestamp) + 1
+    queries = [("name", s, None, end_ts, 10) for s in svcs[:8]]
+    engine.executor.run(queries)  # warm the multi-probe jit rows
+
+    # Steady state: sketch + index loops must add ZERO compiles —
+    # across the ingest jits AND the resident query programs
+    # (dev.query_compile_count, the kernels the executor dispatches).
+    compiles0 = dev.compile_count() + dev.query_compile_count()
+    sk = obs.LatencySketch("q_sketch_s", "sketch-tier serve",
+                           quantiles=(0.5, 0.99))
+    for _ in range(40):
+        t0 = time.perf_counter()
+        engine.service_duration_quantiles(svcs[0], qs)
+        engine.top_annotations(svcs[1 % len(svcs)])
+        engine.get_all_service_names()
+        sk.observe((time.perf_counter() - t0) / 3.0)
+    ix = obs.LatencySketch("q_index_s", "index-tier dispatch",
+                           quantiles=(0.5, 0.99))
+    for _ in range(20):
+        t0 = time.perf_counter()
+        engine.executor.run(queries)  # cache-bypassing resident path
+        ix.observe(time.perf_counter() - t0)
+    recompiles = (dev.compile_count() + dev.query_compile_count()
+                  - compiles0)
+
+    # Cache: hit answers bitwise-equal to the cold computation, and an
+    # ingest commit invalidates precisely (frontier advance).
+    def ids(rows):
+        return [[(i.trace_id, i.timestamp) for i in r] for r in rows]
+
+    hits0 = engine.c_hits.value
+    cold = ids(engine.get_trace_ids_multi(queries))
+    warm = ids(engine.get_trace_ids_multi(queries))
+    cache_hit_ok = (warm == cold
+                    and engine.c_hits.value - hits0 >= len(queries))
+    store.apply(spans[:256])  # frontier advances
+    after = ids(engine.get_trace_ids_multi(queries))
+    fresh = ids(store.get_trace_ids_multi(queries))
+    invalidation_ok = after == fresh
+    sks, ixs = sk.snapshot(), ix.snapshot()
+    return {
+        "spans": len(spans),
+        "sketch_identical": bool(ident),
+        "sketch_p50_ms": round(sks["p50"] * 1e3, 3),
+        "sketch_p99_ms": round(sks["p99"] * 1e3, 3),
+        "index_p50_ms": round(ixs["p50"] * 1e3, 3),
+        "index_p99_ms": round(ixs["p99"] * 1e3, 3),
+        "steady_recompiles": int(recompiles),
+        "cache_hit_identical": bool(cache_hit_ok),
+        "cache_invalidation_exact": bool(invalidation_ok),
+        "cache_hits": int(engine.c_hits.value),
+        "cache_misses": int(engine.c_misses.value),
+        "sketch_answers": int(engine.c_sketch.value),
+    }
+
+
 def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
     import numpy as np  # noqa: F401  (kept: smoke envs import-check it)
 
@@ -526,6 +631,7 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         "archive": run_archive(),
         "pipeline": run_pipeline(),
         "wal": run_wal(),
+        "query": run_query(),
         "spans": total,
         "ingest_spans_per_s": round(total / dt, 1),
         "ingest_ms_per_batch": round(dt / len(dbs) * 1e3, 2),

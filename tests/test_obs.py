@@ -474,3 +474,78 @@ class TestSuspectStore:
         checkpoint.save(store, str(tmp_path / "ckpt3"))
         restored = checkpoint.load(str(tmp_path / "ckpt3"))
         assert restored.counter_block()["spans_seen"] == 2
+
+
+class TestQueryEngineMetricSplit:
+    """The PR 4 ingest observation split applied to reads
+    (query/engine.py): zipkin_query_serve_seconds{tier=...} is
+    end-to-end including sketch/cache hits, zipkin_query_dispatch_
+    seconds isolates actual device launch + D2H — sketch and cache
+    answers must never appear in the dispatch sketch."""
+
+    def _engine_app(self):
+        from zipkin_tpu.store.device import StoreConfig
+        from zipkin_tpu.store.tpu import TpuSpanStore
+        from zipkin_tpu.tracegen import generate_traces
+
+        reg = obs.Registry()
+        store = TpuSpanStore(StoreConfig(
+            capacity=1 << 10, ann_capacity=1 << 12,
+            bann_capacity=1 << 11, max_services=32, max_span_names=64,
+            max_annotation_values=256, max_binary_keys=64,
+            cms_width=1 << 10, hll_p=8, quantile_buckets=256,
+        ), registry=reg)
+        spans = [s for t in generate_traces(n_traces=12, max_depth=3,
+                                            n_services=4) for s in t]
+        store.apply(spans)
+        service = QueryService(store, coalesce_window_s=0.0,
+                               registry=reg)
+        api = ApiServer(service, collector=None, registry=reg)
+        return store, service, api, reg
+
+    def test_serve_dispatch_split_exposed(self):
+        store, service, api, reg = self._engine_app()
+        end_ts = 1 << 61
+        svc0 = sorted(store.get_all_service_names())[0]
+        service.get_service_names()           # sketch tier
+        service.get_span_names(svc0)          # sketch tier
+        q = [("name", svc0, None, end_ts, 5)]
+        service.engine.get_trace_ids_multi(q)  # index tier (dispatch)
+        service.engine.get_trace_ids_multi(q)  # cache tier
+        status, payload = api.handle("GET", "/metrics", {})
+        assert status == 200
+        text = payload.body.decode()
+        assert "# TYPE zipkin_query_serve_seconds summary" in text
+        assert "# TYPE zipkin_query_dispatch_seconds summary" in text
+        for tier in ("sketch", "index", "cache"):
+            assert (f'zipkin_query_serve_seconds{{tier="{tier}"'
+                    in text), (tier, text)
+            assert (f'zipkin_query_serve_seconds_count'
+                    f'{{tier="{tier}"}}' in text), tier
+        assert "zipkin_query_cache_hits_total 1" in text
+        assert "zipkin_query_cache_entries 1" in text
+        # Coalesce amortization sketches (batch size satellite).
+        assert ("# TYPE zipkin_query_coalesce_batch_size summary"
+                in text)
+        assert "zipkin_query_coalesce_batch_queries_count 1" in text
+
+    def test_sketch_and_cache_hits_never_count_as_dispatch(self):
+        store, service, api, reg = self._engine_app()
+        eng = service.engine
+        svc0 = sorted(store.get_all_service_names())[0]
+        q = [("name", svc0, None, 1 << 61, 5)]
+        eng.get_trace_ids_multi(q)  # one real dispatch
+        d0 = eng.h_dispatch.count
+        assert d0 >= 1
+        for _ in range(5):
+            service.get_service_names()                # sketch
+            eng.service_duration_quantiles(svc0, [0.5])  # sketch
+            eng.get_trace_ids_multi(q)                 # cache hit
+        assert eng.h_dispatch.count == d0  # no new device launches
+        serve_sketch = eng.h_serve.labels(tier="sketch").count
+        serve_cache = eng.h_serve.labels(tier="cache").count
+        assert serve_sketch >= 10 and serve_cache >= 5
+        # End-to-end sketch serves stay microsecond-scale (the whole
+        # point): p99 well under the device dispatch floor.
+        p99 = eng.h_serve.labels(tier="sketch").quantile_values([0.99])
+        assert p99[0] < 0.01, p99

@@ -1,0 +1,333 @@
+"""Resident query engine (query/engine.py): sketch-tier exactness vs
+the device read path, frontier-keyed result-cache correctness across
+ingest commits / ring eviction / pin mutations, staleness-freedom
+under concurrent ingest + query threads, and the executor's place in
+the ordered shutdown sequence.
+"""
+
+import threading
+
+import pytest
+
+from zipkin_tpu.ingest.collector import Collector
+from zipkin_tpu.query.engine import QueryEngine
+from zipkin_tpu.query.service import QueryService
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.memory import InMemorySpanStore
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.tracegen import generate_traces
+
+CONFIG = dict(
+    capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+    max_services=32, max_span_names=64, max_annotation_values=256,
+    max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+    quantile_buckets=256,
+)
+SPANS = [s for t in generate_traces(n_traces=40, max_depth=4,
+                                    n_services=6) for s in t]
+END_TS = max(s.last_timestamp for s in SPANS if s.last_timestamp) + 1
+QS = [0.5, 0.95, 0.99]
+
+
+def _store(spans=SPANS, **kw):
+    st = TpuSpanStore(StoreConfig(**{**CONFIG, **kw}))
+    for i in range(0, len(spans), 64):
+        st.apply(spans[i:i + 64])
+    return st
+
+
+def _ids(rows):
+    return [[(i.trace_id, i.timestamp) for i in r] for r in rows]
+
+
+def _assert_sketch_matches_device(engine, store):
+    """Every sketch-tier answer must equal the device read path's."""
+    assert engine.get_all_service_names() == store.get_all_service_names()
+    for svc in sorted(store.get_all_service_names()):
+        assert engine.get_span_names(svc) == store.get_span_names(svc)
+        assert (engine.service_duration_quantiles(svc, QS)
+                == store.service_duration_quantiles(svc, QS)), svc
+        assert engine.top_annotations(svc) == store.top_annotations(svc)
+        assert engine.top_binary_keys(svc) == store.top_binary_keys(svc)
+    assert (engine.estimated_unique_traces()
+            == store.estimated_unique_traces())
+    assert engine.get_span_names("no-such-service") == set()
+    assert engine.service_duration_quantiles("no-such-service", QS) is None
+    assert engine.top_annotations("no-such-service") == []
+
+
+def test_sketch_tier_matches_device_path_exactly():
+    """Incremental mirror deltas: after a serial drive every sketch
+    answer is bitwise the device's, with zero mirror resyncs."""
+    store = _store()
+    engine = QueryEngine(store, window_s=0.0)
+    assert store.sketch_mirror.warm  # never went cold: pure deltas
+    _assert_sketch_matches_device(engine, store)
+    assert engine.c_sketch.value > 0
+
+
+def test_sketch_tier_resync_after_state_adoption():
+    """adopt_state marks the mirror cold; the first sketch read
+    resyncs from the device in one fetch and answers exactly."""
+    store = _store()
+    store.adopt_state(store.state, spans_written=store._wp)
+    assert not store.sketch_mirror.warm
+    engine = QueryEngine(store, window_s=0.0)
+    _assert_sketch_matches_device(engine, store)
+    assert store.sketch_mirror.warm
+
+
+def test_pipelined_ingest_keeps_mirror_exact():
+    """Deltas ride IngestUnits through the pipeline's commit thread;
+    after drain the mirror equals the device aggregates."""
+    store = TpuSpanStore(StoreConfig(**CONFIG))
+    with store.pipelined(4):
+        for i in range(0, len(SPANS), 64):
+            store.apply(SPANS[i:i + 64])
+        store.drain_pipeline()
+        engine = QueryEngine(store, window_s=0.0)
+        _assert_sketch_matches_device(engine, store)
+
+
+def test_result_cache_hits_are_bitwise_equal_and_frontier_keyed():
+    store = _store()
+    engine = QueryEngine(store, window_s=0.0)
+    svcs = sorted(store.get_all_service_names())
+    queries = [("name", s, None, END_TS, 10) for s in svcs]
+    cold = _ids(engine.get_trace_ids_multi(queries))
+    h0, m0 = engine.c_hits.value, engine.c_misses.value
+    warm = _ids(engine.get_trace_ids_multi(queries))
+    assert warm == cold  # bitwise-equal hit
+    assert engine.c_hits.value - h0 == len(queries)
+    assert engine.c_misses.value == m0
+    # Row reads cache too, and copies protect the cached value.
+    tids = [t for r in cold for t, _ in r][:4]
+    spans1 = engine.get_spans_by_trace_ids(tids)
+    spans2 = engine.get_spans_by_trace_ids(tids)
+    assert spans1 == spans2
+    spans2[0].clear()  # mutating the returned copy ...
+    assert engine.get_spans_by_trace_ids(tids) == spans1  # ... is safe
+    assert engine.traces_exist(tids) == store.traces_exist(tids)
+    assert (engine.get_traces_duration(tids)
+            == store.get_traces_duration(tids))
+
+
+def test_result_cache_invalidates_on_ingest_commit():
+    """A commit advances the frontier: the next read recomputes and
+    matches a fresh store read (no stale entry can ever be served)."""
+    store = _store()
+    engine = QueryEngine(store, window_s=0.0)
+    svcs = sorted(store.get_all_service_names())
+    queries = [("name", s, None, 1 << 61, 50) for s in svcs]
+    f0 = store.write_frontier()
+    engine.get_trace_ids_multi(queries)  # fills at f0
+    extra = [s for t in generate_traces(n_traces=10, max_depth=3,
+                                        n_services=6) for s in t]
+    store.apply(extra)
+    assert store.write_frontier() != f0
+    after = _ids(engine.get_trace_ids_multi(queries))
+    assert after == _ids(store.get_trace_ids_multi(queries))
+    # The new spans are actually visible through the engine.
+    new_tid = extra[0].trace_id
+    assert engine.traces_exist([new_tid]) == {new_tid}
+
+
+def test_result_cache_invalidates_on_pin_and_ttl_mutation():
+    """Pin/TTL changes alter read answers without a device commit —
+    the read epoch component of the frontier covers them."""
+    store = _store()
+    engine = QueryEngine(store, window_s=0.0)
+    tid = SPANS[0].trace_id
+    before = engine.get_spans_by_trace_ids([tid])
+    f0 = store.write_frontier()
+    store.set_time_to_live(tid, 3600.0)  # pin
+    assert store.write_frontier() != f0
+    assert engine.get_spans_by_trace_ids([tid]) == \
+        store.get_spans_by_trace_ids([tid])
+    assert before  # the trace existed all along
+
+
+def test_cache_and_executor_exact_through_eviction_capture():
+    """Tiered store, 4×-ring drive with queries interleaved: engine
+    answers (which cache across the laps) always match the memory
+    oracle, including spans only the cold tier still holds."""
+    from zipkin_tpu.store.archive import ArchiveParams, TieredSpanStore
+
+    # test_archive.CFG geometry: the suite's jit cache is already
+    # warm at these shapes.
+    cfg = StoreConfig(
+        capacity=1 << 8, ann_capacity=1 << 10, bann_capacity=1 << 9,
+        max_services=16, max_span_names=64, max_annotation_values=128,
+        max_binary_keys=32, cms_width=1 << 9, hll_p=6,
+        quantile_buckets=256,
+    )
+    n = 4 * cfg.capacity
+    spans = [s for t in generate_traces(n_traces=n // 4, max_depth=3,
+                                        n_services=8) for s in t][:n]
+    hot = TpuSpanStore(cfg)
+    tiered = TieredSpanStore(hot, params=ArchiveParams.for_config(
+        cfg, compact_fanin=2, small_span_limit=cfg.capacity,
+        bloom_bits=1 << 12, cms_width=1 << 10, hll_p=6,
+    ))
+    oracle = InMemorySpanStore()
+    engine = QueryEngine(tiered, window_s=0.0)
+    svc0 = None
+    for i in range(0, len(spans), 128):
+        tiered.apply(spans[i:i + 128])
+        oracle.apply(spans[i:i + 128])
+        if svc0 is None:
+            svc0 = sorted(oracle.get_all_service_names())[0]
+        # Interleaved query: fills the cache at this frontier ...
+        engine.get_trace_ids_by_name(svc0, None, 1 << 61, 8)
+    # ... and the final answers (cache long invalidated by later
+    # commits) match the oracle exactly, evicted spans included.
+    tids = sorted({s.trace_id for s in spans})
+    sample = tids[:3] + tids[len(tids) // 2:len(tids) // 2 + 3] + tids[-3:]
+    for t in sample:
+        assert (engine.get_spans_by_trace_ids([t])
+                == oracle.get_spans_by_trace_ids([t])), t
+        assert (engine.get_spans_by_trace_ids([t])
+                == oracle.get_spans_by_trace_ids([t])), t  # cached hit
+    assert (_ids(engine.get_trace_ids_multi(
+        [("name", svc0, None, 1 << 61, 10 * n)]))
+        == _ids([oracle.get_trace_ids_by_name(svc0, None, 1 << 61,
+                                              10 * n)]))
+    # Sketch federation: catalog includes cold-only services.
+    assert (engine.get_all_service_names()
+            == tiered.get_all_service_names()
+            == oracle.get_all_service_names())
+    tiered.close()
+
+
+def test_staleness_freedom_under_concurrent_ingest_and_query():
+    """Writers and engine readers race; reads never error, and once
+    writes drain every answer equals a fresh store read AND the
+    memory oracle."""
+    store = _store(spans=SPANS[:64])
+    oracle = InMemorySpanStore()
+    oracle.apply(SPANS[:64])
+    engine = QueryEngine(store, window_s=0.0)
+    rest = SPANS[64:]
+    errors = []
+    stop = threading.Event()
+
+    def write():
+        try:
+            for i in range(0, len(rest), 32):
+                store.apply(rest[i:i + 32])
+                oracle.apply(rest[i:i + 32])
+        finally:
+            stop.set()
+
+    svc0 = sorted(store.get_all_service_names())[0]
+
+    def read():
+        try:
+            while not stop.is_set():
+                engine.get_trace_ids_multi(
+                    [("name", svc0, None, END_TS, 10)])
+                engine.get_all_service_names()
+                engine.traces_exist([SPANS[0].trace_id])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=write)] + [
+        threading.Thread(target=read) for _ in range(3)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    engine.drain()
+    _assert_sketch_matches_device(engine, store)
+    svcs = sorted(oracle.get_all_service_names())
+    assert engine.get_all_service_names() == set(svcs)
+    queries = [("name", s, None, 1 << 61, 50) for s in svcs]
+    assert (_ids(engine.get_trace_ids_multi(queries))
+            == _ids(store.get_trace_ids_multi(queries))
+            == _ids([oracle.get_trace_ids_by_name(s, None, 1 << 61, 50)
+                     for s in svcs]))
+
+
+def test_executor_joins_ordered_shutdown():
+    """The engine registers on the store; Collector.flush drains the
+    standing executor, Collector.close stops it before the store
+    closes — and queries still answer inline afterwards."""
+    store = TpuSpanStore(StoreConfig(**CONFIG))
+    collector = Collector(store, self_trace=False, concurrency=2)
+    service = QueryService(store, coalesce_window_s=0.0)
+    engine = service.engine
+    assert engine in store.query_engines()
+    collector.accept(SPANS[:64])
+    collector.flush()  # drain-queries → drain-pipeline → seal → fsync
+    svc0 = sorted(store.get_all_service_names())[0]
+    want = _ids(engine.get_trace_ids_multi(
+        [("name", svc0, None, END_TS, 10)]))
+    assert want and want[0]  # the flushed spans are queryable
+    collector.close()
+    assert engine.executor.closed
+    assert not engine.executor._thread.is_alive()
+    # Inline fallback: identical answers, no standing thread.
+    got = _ids(engine.get_trace_ids_multi(
+        [("name", svc0, None, END_TS, 10)]))
+    assert got == want
+
+
+def test_checkpoint_save_drains_executor(tmp_path):
+    """checkpoint.save quiesces registered engines before the gather
+    (no query launch in flight when the consistent cut is taken), and
+    a restored store's mirror resyncs to exact sketch answers."""
+    from zipkin_tpu import checkpoint
+
+    store = _store()
+    engine = QueryEngine(store, window_s=0.0)
+    drained = []
+    orig = engine.drain
+    engine.drain = lambda: (drained.append(True), orig())[1]
+    checkpoint.save(store, str(tmp_path / "ckpt"))
+    assert drained
+    restored = checkpoint.load(str(tmp_path / "ckpt"))
+    assert not restored.sketch_mirror.warm
+    engine2 = QueryEngine(restored, window_s=0.0)
+    _assert_sketch_matches_device(engine2, restored)
+
+
+def test_window_plumbs_end_to_end():
+    """--query-window-ms → QueryService → engine → executor, plus the
+    runtime /vars/queryWindowMs route."""
+    from zipkin_tpu.api.server import ApiServer
+    from zipkin_tpu.main.example import build_parser
+
+    args = build_parser().parse_args(["--query-window-ms", "7"])
+    assert args.query_window_ms == 7.0
+    store = InMemorySpanStore()
+    store.apply(SPANS[:16])
+    service = QueryService(store, coalesce_window_s=7 / 1000.0)
+    assert service.engine.window_s == pytest.approx(0.007)
+    api = ApiServer(service, collector=None)
+    code, body = api.handle("GET", "/vars/queryWindowMs", {})
+    assert code == 200 and body["queryWindowMs"] == pytest.approx(7.0)
+    code, body = api.handle("POST", "/vars/queryWindowMs", {}, b"3.5")
+    assert code == 200 and body["queryWindowMs"] == pytest.approx(3.5)
+    assert service.engine.window_s == pytest.approx(0.0035)
+
+
+def test_engine_on_host_store_is_transparent():
+    """Memory/sql backends: no mirror, no frontier — the engine is a
+    pure facade with identical answers."""
+    store = InMemorySpanStore()
+    store.apply(SPANS)
+    engine = QueryEngine(store, window_s=0.0)
+    svcs = sorted(store.get_all_service_names())
+    assert engine.get_all_service_names() == set(svcs)
+    for s in svcs[:3]:
+        assert engine.get_span_names(s) == store.get_span_names(s)
+        assert (_ids(engine.get_trace_ids_multi(
+            [("name", s, None, END_TS, 10)]))
+            == _ids([store.get_trace_ids_by_name(s, None, END_TS, 10)]))
+    tid = SPANS[0].trace_id
+    assert (engine.get_spans_by_trace_ids([tid])
+            == store.get_spans_by_trace_ids([tid]))
+    # No frontier ⇒ nothing cached, ever.
+    assert len(engine.cache) == 0

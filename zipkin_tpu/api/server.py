@@ -178,6 +178,14 @@ class ApiServer:
                 lambda: collector.sampler.rate,
                 lambda v: setattr(collector.sampler, "rate", float(v)),
             )
+        # The resident executor's micro-batch window, adjustable at
+        # runtime (ms — matches the daemon's --query-window-ms flag):
+        # GET /vars/queryWindowMs, POST /vars/queryWindowMs <number>.
+        if coal is not None and hasattr(coal, "window_s"):
+            self.vars["queryWindowMs"] = (
+                lambda: coal.window_s * 1000.0,
+                lambda v: setattr(coal, "window_s", float(v) / 1000.0),
+            )
 
     # -- dispatch -------------------------------------------------------
 
@@ -567,6 +575,15 @@ class ApiServer:
                 "query.coalesce_queries": coal.queries,
                 "query.coalesce_launches_saved": coal.launches_saved,
                 "query.coalesce_max_batch": coal.max_batch,
+            })
+        eng = getattr(self.query, "engine", None)
+        if eng is not None:
+            # Resident-engine tier accounting (docs/QUERY_ENGINE.md).
+            out.update({
+                "query.cache_hits": eng.c_hits.value,
+                "query.cache_misses": eng.c_misses.value,
+                "query.cache_entries": len(eng.cache),
+                "query.sketch_answers": eng.c_sketch.value,
             })
         return out
 

@@ -282,6 +282,13 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
     fingerprint so a write between attempts discards the stage rather
     than mixing two cuts). Returns transfer stats (slab count/bytes/
     bandwidth, resumed leaf count)."""
+    # Resident-query-executor quiesce (query/engine.py): wait for any
+    # in-flight coalesced query launch to finish before the gather
+    # begins, so the snapshot's device cut never interleaves with a
+    # standing executor's batch mid-dispatch (the ordered-shutdown
+    # contract: drain-queries → drain-pipeline → seal → gather).
+    for eng in getattr(store, "query_engines", lambda: ())():
+        eng.drain()
     # A TieredSpanStore (store/archive) snapshots as its hot device
     # store plus the segment manifest; the segments themselves are
     # immutable host blobs, so they add host IO only — never device
@@ -855,6 +862,10 @@ def load(path: str, mesh=None):
     # re-cuts the uncrashed drive's launches bitwise (wal/recovery).
     store._wp = int(store.state.write_pos)
     store._archived = store._wp
+    # The restored aggregates were never deltas on this process's
+    # sketch mirror: resync lazily on first sketch-tier read.
+    if hasattr(store, "sketch_mirror"):
+        store.sketch_mirror.mark_cold()
     clocks = meta.get("clocks")
     if clocks:
         store._archived = int(clocks["archived"])

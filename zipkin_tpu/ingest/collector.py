@@ -368,14 +368,24 @@ class Collector:
         if drain is not None:
             drain()
 
+    def _drain_query_engines(self) -> None:
+        """Quiesce the resident query executors registered on the
+        store (query/engine.py): wait until no coalesced query launch
+        is in flight, so the drain→seal→fsync→checkpoint sequence
+        below never interleaves with a standing executor's dispatch."""
+        for engine in getattr(self.store, "query_engines",
+                              lambda: ())():
+            engine.drain()
+
     def _quiesce_store(self) -> None:
         """Durability-ordered drain of the store's async machinery:
-        drain-pipeline → seal-barrier → WAL-fsync (docs/DURABILITY.md
-        shutdown ordering — each step's output is the next step's
-        input: committed units may pull capture windows, sealed
-        windows advance the frontier a checkpoint cuts at, and the
-        fsync makes every journaled record durable before any
-        checkpoint claims to cover it)."""
+        drain-queries → drain-pipeline → seal-barrier → WAL-fsync
+        (docs/DURABILITY.md shutdown ordering — each step's output is
+        the next step's input: committed units may pull capture
+        windows, sealed windows advance the frontier a checkpoint cuts
+        at, and the fsync makes every journaled record durable before
+        any checkpoint claims to cover it)."""
+        self._drain_query_engines()
         self._drain_store_pipeline()
         barrier = getattr(self.store, "seal_barrier", None)
         if barrier is not None:
@@ -397,6 +407,13 @@ class Collector:
         self.queue.close()
         self._flush_self_spans()
         self._quiesce_store()
+        # Stop the resident query executors for good BEFORE the store
+        # tears down its own async machinery — a standing executor
+        # thread must not launch against a closing store. Queries
+        # after this still answer (inline, uncoalesced).
+        for engine in getattr(self.store, "query_engines",
+                              lambda: ())():
+            engine.close()
         # store.close() stops the ingest pipeline (draining accepted
         # batches) and the capture sealer before returning.
         self.store.close()

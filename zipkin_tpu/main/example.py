@@ -75,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="roll WAL segment files at this size; whole "
                         "segments are deleted once a checkpoint "
                         "covers them")
+    p.add_argument("--query-window-ms", type=float, default=None,
+                   help="resident query executor micro-batch window "
+                        "(ms): how long an idle-entry request waits "
+                        "for company before its coalesced device "
+                        "launch (default: 2 ms on device stores, 0 on "
+                        "the memory store; runtime-adjustable via "
+                        "/vars/queryWindowMs — docs/QUERY_ENGINE.md)")
     p.add_argument("--seed-traces", type=int, default=0,
                    help="generate N synthetic traces at startup")
     p.add_argument("--checkpoint", default=None,
@@ -201,7 +208,10 @@ def build_app(args):
         self_trace=not args.no_self_trace_ingest,
         pipeline_depth=args.pipeline_depth,
     )
-    api = ApiServer(QueryService(store), collector)
+    window_s = (args.query_window_ms / 1000.0
+                if args.query_window_ms is not None else None)
+    api = ApiServer(QueryService(store, coalesce_window_s=window_s),
+                    collector)
     return store, collector, api
 
 

@@ -301,6 +301,16 @@ class ShardedSpanStore(SuspectGuard):
     def close(self) -> None:
         pass
 
+    # -- resident query engines (query/engine.py; the duck-typed twin
+    # of ReadSpanStore's registry, so Collector.flush/close and
+    # checkpoint.save can join the executor thread's lifecycle) ------
+
+    def register_query_engine(self, engine) -> None:
+        self.__dict__.setdefault("_query_engines", []).append(engine)
+
+    def query_engines(self):
+        return list(self.__dict__.get("_query_engines", ()))
+
     # -- writes ---------------------------------------------------------
 
     def _shard_of(self, trace_id: int) -> int:

@@ -15,5 +15,9 @@ from zipkin_tpu.query.request import (  # noqa: F401
     QueryResponse,
 )
 from zipkin_tpu.query.adjusters import TimeSkewAdjuster  # noqa: F401
-from zipkin_tpu.query.coalesce import QueryCoalescer  # noqa: F401
+from zipkin_tpu.query.coalesce import (  # noqa: F401
+    QueryCoalescer,
+    ResidentCoalescer,
+)
+from zipkin_tpu.query.engine import QueryEngine  # noqa: F401
 from zipkin_tpu.query.service import QueryService  # noqa: F401

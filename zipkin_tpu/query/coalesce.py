@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Sequence
+from typing import Callable, List, Optional, Sequence
 
 
 class _Slot:
@@ -41,6 +41,178 @@ class _Slot:
         self.results = None
         self.error = None
         self.done = False
+
+
+class ResidentCoalescer:
+    """Standing micro-batch executor: the QueryCoalescer's leader
+    election generalized into ONE continuously-running thread
+    (query/engine.py's index tier rides this).
+
+    Double-buffered staging: while the executor thread has a batch on
+    the device, new arrivals accumulate in ``_pending`` (the second
+    buffer); the thread swaps the buffers the moment the launch
+    returns, so consecutive batches pipeline back-to-back with no
+    leader re-election and no per-request window sleep once traffic is
+    continuous — the Ragged-Paged-Attention dispatch shape (PAPERS.md):
+    one persistent compiled program fed micro-batches.
+
+    ``window_s`` only applies when the executor went idle: the first
+    request of a quiet period waits at most one window for company.
+    A batch that accumulated DURING a previous launch dispatches
+    immediately (the launch itself was the window). The attribute is
+    writable at runtime (daemon ``/vars/queryWindowMs``).
+
+    ``run`` semantics, accounting fields, and error propagation match
+    QueryCoalescer exactly (tests/test_coalesce.py drives both).
+    After ``close()`` the thread is gone and ``run`` degrades to
+    inline per-caller execution — queries still answer during and
+    after an ordered shutdown.
+    """
+
+    def __init__(self, store, window_s: float = 0.0, registry=None,
+                 dispatch_timer: Optional[Callable[[float], None]] = None):
+        self.store = store
+        self.window_s = window_s
+        self._dispatch_timer = dispatch_timer
+        self._cv = threading.Condition()
+        self._pending: List[_Slot] = []
+        self._inflight = 0  # slots in the batch currently executing
+        self._closed = False
+        self.batches = 0
+        self.queries = 0
+        self.launches_saved = 0
+        self.max_batch = 0
+        from zipkin_tpu import obs
+
+        reg = registry or obs.default_registry()
+        self._h_batch = reg.register(obs.LatencySketch(
+            "zipkin_query_coalesce_batch_queries",
+            "Queries per coalesced device launch (size distribution)",
+            min_value=1.0))
+        # Requests (slots) per launch — the amortization observable:
+        # mean > 1 means concurrent requests genuinely shared launches.
+        self._h_size = reg.register(obs.LatencySketch(
+            "zipkin_query_coalesce_batch_size",
+            "Concurrent requests sharing one coalesced device launch",
+            min_value=1.0))
+        # Started lazily on the first coalesced run(): a QueryService
+        # constructed for a handful of reads (tests, read-only library
+        # embedding) never pays a standing thread it didn't use.
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self) -> None:
+        # Caller holds _cv and has checked not-closed.
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="zipkin-query-exec", daemon=True)
+            self._thread.start()
+
+    def run(self, queries: Sequence[tuple]) -> List[list]:
+        """Resolve ``queries`` (SpanStore.get_trace_ids_multi tuples),
+        sharing the standing executor's next launch with every
+        concurrent caller. Results are exactly serial execution's."""
+        queries = list(queries)
+        if not queries:
+            return []
+        slot = _Slot(queries)
+        with self._cv:
+            if not self._closed:
+                self._ensure_thread()
+                self._pending.append(slot)
+                self._cv.notify_all()
+                while not slot.done:
+                    self._cv.wait()
+                if slot.error is not None:
+                    raise slot.error
+                return slot.results
+        # Executor stopped (ordered shutdown): inline fallback.
+        self._execute([slot])
+        if slot.error is not None:
+            raise slot.error
+        return slot.results
+
+    # -- executor thread -------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                waited = False
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                    waited = True
+                if self._closed and not self._pending:
+                    return
+            # Idle-entry window only: a batch built while the previous
+            # launch ran needs no extra wait (see class docstring).
+            w = self.window_s
+            if waited and w and w > 0:
+                time.sleep(w)
+            with self._cv:
+                batch, self._pending = self._pending, []
+                self._inflight = len(batch)
+            try:
+                self._execute(batch)
+            finally:
+                with self._cv:
+                    self._inflight = 0
+                    self._cv.notify_all()
+
+    def _execute(self, batch: List[_Slot]) -> None:
+        """Run one batch through ONE get_trace_ids_multi call and
+        resolve every slot (on error: every slot, same error)."""
+        err = None
+        try:
+            flat = [q for s in batch for q in s.queries]
+            t0 = time.perf_counter()
+            res = self.store.get_trace_ids_multi(flat)
+            if self._dispatch_timer is not None:
+                self._dispatch_timer(time.perf_counter() - t0)
+            i = 0
+            for s in batch:
+                s.results = res[i:i + len(s.queries)]
+                i += len(s.queries)
+        except BaseException as e:  # noqa: BLE001 — delivered per slot
+            err = e
+        with self._cv:
+            n_q = 0
+            for s in batch:
+                if s.results is None and s.error is None:
+                    s.error = err or RuntimeError("executor died")
+                s.done = True
+                n_q += len(s.queries)
+            self.batches += 1
+            self.queries += n_q
+            self.launches_saved += len(batch) - 1
+            self.max_batch = max(self.max_batch, len(batch))
+            self._cv.notify_all()
+        self._h_batch.observe(max(n_q, 1))
+        self._h_size.observe(max(len(batch), 1))
+
+    # -- lifecycle -------------------------------------------------------
+
+    def drain(self) -> None:
+        """Block until the executor is idle: nothing pending, nothing
+        in flight. The quiesce barrier Collector.flush/checkpoint.save
+        use — after it returns, no query launch predating the call is
+        still on the device."""
+        with self._cv:
+            while self._pending or self._inflight:
+                self._cv.wait(timeout=0.5)
+
+    def close(self) -> None:
+        """Stop the executor thread (processing everything already
+        queued); later run() calls execute inline."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
 
 class QueryCoalescer:
@@ -75,6 +247,10 @@ class QueryCoalescer:
         self._h_batch = reg.register(obs.LatencySketch(
             "zipkin_query_coalesce_batch_queries",
             "Queries per coalesced device launch (size distribution)",
+            min_value=1.0))
+        self._h_size = reg.register(obs.LatencySketch(
+            "zipkin_query_coalesce_batch_size",
+            "Concurrent requests sharing one coalesced device launch",
             min_value=1.0))
 
     def run(self, queries: Sequence[tuple]) -> List[list]:
@@ -144,6 +320,7 @@ class QueryCoalescer:
                 self.max_batch = max(self.max_batch, len(batch))
                 self._cv.notify_all()
             self._h_batch.observe(max(n_q, 1))
+            self._h_size.observe(max(len(batch), 1))
         if slot.error is not None:
             raise slot.error
         return slot.results

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Sequence
 from zipkin_tpu.models.span import Span
 from zipkin_tpu.models.trace import Trace, TraceCombo, TraceSummary, TraceTimeline
 from zipkin_tpu.query.adjusters import TimeSkewAdjuster
-from zipkin_tpu.query.coalesce import QueryCoalescer
+from zipkin_tpu.query.engine import DEFAULT_COALESCE_WINDOW_S, QueryEngine
 from zipkin_tpu.query.request import (
     Order,
     QueryException,
@@ -28,10 +28,11 @@ from zipkin_tpu.store.base import IndexedTraceId, SpanStore
 # ThriftQueryService.scala:33).
 TRACE_TIMESTAMP_PADDING_US = 60 * 1_000_000
 DURATION_FETCH_BATCH = 500
-# Cross-request micro-batch window (s): concurrent getTraceIds calls
-# arriving within it share ONE device launch (query/coalesce.py) —
-# the read-path answer to the ~100 ms per-dispatch floor.
-DEFAULT_COALESCE_WINDOW_S = 0.002
+
+__all__ = [
+    "DEFAULT_COALESCE_WINDOW_S", "DURATION_FETCH_BATCH", "QueryService",
+    "TRACE_TIMESTAMP_PADDING_US",
+]
 
 
 class QueryService:
@@ -42,36 +43,35 @@ class QueryService:
         duration_batch: int = DURATION_FETCH_BATCH,
         coalesce_window_s: Optional[float] = None,
         registry=None,
+        engine: Optional[QueryEngine] = None,
     ):
         self.store = store
         self.adjust_clock_skew = adjust_clock_skew
         self.duration_batch = duration_batch
-        if coalesce_window_s is None:
-            # The window only pays against a per-dispatch floor. A
-            # store that overrides get_trace_ids_multi (the device
-            # stores' one-launch batched probe) gets the 2 ms window;
-            # host backends (memory/sql — the base class just loops
-            # the singular methods) keep window 0, so a lone request
-            # pays no sleep and concurrent ones still coalesce only
-            # when a batch is already in flight.
-            from zipkin_tpu.store.base import ReadSpanStore
+        # EVERY read routes through the resident query engine
+        # (query/engine.py): sketch-answerable queries come off the
+        # host mirror with zero device round-trips, trace-id lookups
+        # share the standing executor's launches, and repeat reads hit
+        # the frontier-keyed result cache — with answers exactly equal
+        # to direct store execution's. ``coalesce_window_s`` is the
+        # executor's idle-entry micro-batch window (None = 2 ms for
+        # batched device stores, 0 for host backends).
+        self.engine = engine or QueryEngine(
+            store, window_s=coalesce_window_s, registry=registry)
+        # Back-compat alias: the executor exposes the coalescer's
+        # run()/accounting surface (ApiServer's gauges read it).
+        self.coalescer = self.engine.executor
 
-            batched = (type(store).get_trace_ids_multi
-                       is not ReadSpanStore.get_trace_ids_multi)
-            coalesce_window_s = (
-                DEFAULT_COALESCE_WINDOW_S if batched else 0.0
-            )
-        # EVERY trace-id lookup (not just the multi-slice rounds)
-        # routes through the coalescer, so N concurrent API requests
-        # cost one batched get_trace_ids_multi launch instead of N
-        # singular dispatches; results are exactly serial execution's
-        # (see QueryCoalescer).
-        self.coalescer = QueryCoalescer(store,
-                                        window_s=coalesce_window_s,
-                                        registry=registry)
+    def close(self) -> None:
+        """Stop the engine's standing executor thread and deregister
+        it from the store. Library consumers embedding a QueryService
+        without a Collector own this call; under the daemon,
+        Collector.close() reaches the same engines via the store
+        registry, so both orders are safe (close is idempotent)."""
+        self.engine.close()
 
     def _multi(self, queries) -> List[List[IndexedTraceId]]:
-        return self.coalescer.run(queries)
+        return self.engine.get_trace_ids_multi(queries)
 
     # -- getTraceIds ----------------------------------------------------
 
@@ -161,7 +161,8 @@ class QueryService:
         durations = []
         for i in range(0, len(tids), self.duration_batch):
             durations.extend(
-                self.store.get_traces_duration(tids[i:i + self.duration_batch])
+                self.engine.get_traces_duration(
+                    tids[i:i + self.duration_batch])
             )
         rev = order is Order.DURATION_DESC
         return [
@@ -174,7 +175,7 @@ class QueryService:
     def get_traces_by_ids(self, trace_ids: Sequence[int],
                           adjust: Optional[bool] = None) -> List[Trace]:
         adjust = self.adjust_clock_skew if adjust is None else adjust
-        found = self.store.get_spans_by_trace_ids(trace_ids)
+        found = self.engine.get_spans_by_trace_ids(trace_ids)
         traces = [Trace(spans) for spans in found]
         if adjust:
             adjuster = TimeSkewAdjuster()
@@ -207,7 +208,7 @@ class QueryService:
         ]
 
     def trace_exists(self, trace_id: int) -> bool:
-        return bool(self.store.traces_exist([trace_id]))
+        return bool(self.engine.traces_exist([trace_id]))
 
     def traces_exist(self, trace_ids: Sequence[int]):
         """Which of ``trace_ids`` have any stored span — the thrift
@@ -215,15 +216,15 @@ class QueryService:
         every backend's batched membership read (the TPU store answers
         through the trace-membership gid buckets when their exactness
         gate holds)."""
-        return self.store.traces_exist(trace_ids)
+        return self.engine.traces_exist(trace_ids)
 
     # -- catalogs / aggregates -----------------------------------------
 
     def get_service_names(self):
-        return self.store.get_all_service_names()
+        return self.engine.get_all_service_names()
 
     def get_span_names(self, service: str):
-        return self.store.get_span_names(service)
+        return self.engine.get_span_names(service)
 
     def get_dependencies(self, start_ts: Optional[int] = None,
                          end_ts: Optional[int] = None):
@@ -236,26 +237,29 @@ class QueryService:
         store) behave like NullAggregates and return zero."""
         from zipkin_tpu.models.dependencies import Dependencies
 
-        getter = getattr(self.store, "get_dependencies", None)
-        if getter is None:
+        if not hasattr(self.engine.store, "get_dependencies"):
             return Dependencies.zero()
-        return getter(start_ts, end_ts)
+        return self.engine.get_dependencies(start_ts, end_ts)
 
     def get_top_annotations(self, service: str, k: int = 10) -> List[str]:
-        getter = getattr(self.store, "top_annotations", None)
-        return [a for a, _ in getter(service, k)] if getter else []
+        if not hasattr(self.engine.store, "top_annotations"):
+            return []
+        return [a for a, _ in self.engine.top_annotations(service, k)]
 
     def get_top_key_value_annotations(self, service: str, k: int = 10
                                       ) -> List[str]:
-        getter = getattr(self.store, "top_binary_keys", None)
-        return [a for a, _ in getter(service, k)] if getter else []
+        if not hasattr(self.engine.store, "top_binary_keys"):
+            return []
+        return [a for a, _ in self.engine.top_binary_keys(service, k)]
 
     def get_service_duration_quantiles(self, service: str, qs):
         """Per-service latency percentiles off the device histogram
         (BASELINE config #4; the aggregates-page data the reference
         computed offline). Stores without the histogram return None."""
-        getter = getattr(self.store, "service_duration_quantiles", None)
-        return getter(service, list(qs)) if getter else None
+        if not hasattr(self.engine.store,
+                       "service_duration_quantiles"):
+            return None
+        return self.engine.service_duration_quantiles(service, list(qs))
 
     def set_trace_time_to_live(self, trace_id: int, ttl_s: float) -> None:
         self.store.set_time_to_live(trace_id, ttl_s)
@@ -281,7 +285,7 @@ class QueryService:
         ids = self._multi([
             ("name", service_name, rpc_name, time_stamp, limit)
         ])[0]
-        return self.store.get_spans_by_trace_ids(
+        return self.engine.get_spans_by_trace_ids(
             [i.trace_id for i in ids])
 
     def get_span_durations(self, time_stamp: int, service_name: str,

@@ -117,15 +117,36 @@ class TieredSpanStore(SpanStore):
         tid = to_signed64(trace_id)
         with hot._lock:
             hot.ttls[tid] = ttl_seconds
+            hot._bump_read_epoch()
             pin = ttl_seconds > hot.DEFAULT_TTL_S
             if not pin:
                 hot.pins.unpin(tid)
         if pin:
             fill_pin(hot.pins, hot._lock, tid, lambda: (
                 self.get_spans_by_trace_ids([trace_id]) or [[]])[0])
+            with hot._lock:
+                hot._bump_read_epoch()  # bank filled: reads widened
 
     def get_time_to_live(self, trace_id: int) -> float:
         return self.hot.get_time_to_live(trace_id)
+
+    def write_frontier(self):
+        """The hot store's commit frontier keys the result cache for
+        the WHOLE federation: cold-tier content only changes through
+        hot commits (capture windows are pulled inside the committing
+        write's lock hold, and cold reads run behind seal_barrier), so
+        a fixed hot frontier pins the federated answer too."""
+        return self.hot.write_frontier()
+
+    def cold_service_ids(self) -> Set[int]:
+        """Service ids present in any cold segment, from zone-map
+        metadata alone (host memory, no decompression) — the sketch
+        tier's cold half of getAllServiceNames (exact: zone service
+        sets are exact per segment, see archive/segment.py)."""
+        out: Set[int] = set()
+        for seg in self._segments():
+            out.update(seg.zone.service_ids)
+        return out
 
     def capture_now(self) -> None:
         """Flush everything resident-but-uncaptured into a segment."""

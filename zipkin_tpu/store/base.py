@@ -539,6 +539,18 @@ class WriteSpanStore(SuspectGuard, abc.ABC):
 
 
 class ReadSpanStore(abc.ABC):
+    # -- resident query engines (query/engine.py) ----------------------
+    # Engines register here so lifecycle owners that only hold the
+    # store (Collector.flush/close, checkpoint.save) can join the
+    # executor thread into the ordered drain→seal→fsync→checkpoint
+    # sequence without knowing the query layer.
+
+    def register_query_engine(self, engine) -> None:
+        self.__dict__.setdefault("_query_engines", []).append(engine)
+
+    def query_engines(self):
+        return list(self.__dict__.get("_query_engines", ()))
+
     @abc.abstractmethod
     def get_time_to_live(self, trace_id: int) -> float:
         ...

@@ -3126,6 +3126,18 @@ _INGEST_JITS = (
     rebuild_span_tab, _capture_impl,
 )
 
+# The resident query programs (query/engine.py's index tier): the
+# batched multi-probe kernel plus every read kernel the engine's
+# cached paths dispatch. A warmed steady state must hold their cache
+# sizes flat — bench_smoke's query phase and bench.py's query-engine
+# phase gate query_compile_count() deltas at ZERO.
+_QUERY_JITS = (
+    _iq_multi_impl, _iq_service_impl, _iq_verify_impl,
+    _iq_verify2_impl, _iq_durations_impl, _iq_gather_impl,
+    _q_by_service_impl, _q_by_annotation_impl, _q_durations_impl,
+    _gather_impl, counter_block,
+)
+
 
 def compile_count() -> int:
     """Total compiled variants (jit cache entries) across the ingest /
@@ -3135,6 +3147,22 @@ def compile_count() -> int:
     ZERO across a warmed pipelined drive."""
     total = 0
     for fn in _INGEST_JITS:
+        try:
+            total += fn._cache_size()
+        except Exception:  # pragma: no cover — jax internals moved
+            pass
+    return total
+
+
+def query_compile_count() -> int:
+    """Compiled variants across the resident query kernels
+    (_QUERY_JITS) — the query-path twin of ``compile_count``. A
+    resident executor serving steady traffic must hold this flat:
+    every dispatch hits an already-compiled program (pow2 probe
+    padding bounds the shape space). Surfaced through
+    ``TpuSpanStore.counters()`` → /metrics as ``query_jit_compiles``."""
+    total = 0
+    for fn in _QUERY_JITS:
         try:
             total += fn._cache_size()
         except Exception:  # pragma: no cover — jax internals moved

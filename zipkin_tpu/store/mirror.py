@@ -1,0 +1,241 @@
+"""Host-mirrored monoid sketches: the query engine's zero-dispatch tier.
+
+The fused ingest step maintains six LIFETIME aggregate arrays on the
+device — per-service duration log-histogram, annotation-host service
+counts, span-name presence, top-annotation / top-binary-key count
+matrices, and the distinct-trace HyperLogLog. Every one of them is a
+monoid updated by a masked scatter-add (or scatter-max) over the
+batch's columns, and every input to that scatter is ALREADY ON THE
+HOST in stage 1 of the write path (the encoded ``SpanBatch`` plus the
+``name_lc``/``indexable`` sidecars). So the aggregates can be mirrored
+host-side for free: stage 1 computes a tiny COO delta per launch unit
+(``SketchMirror.delta_of``), and the commit stage folds it in inside
+the SAME write-lock hold as the donating device swap
+(``TpuSpanStore._commit_unit``) — the mirror is never behind the
+store's write frontier, and answering quantiles / top-k / cardinality
+/ catalog queries costs ZERO device round-trips (the ~110 ms dispatch
+floor the resident query engine exists to kill; see
+docs/QUERY_ENGINE.md).
+
+Exactness contract: the mirror's arrays are numerically IDENTICAL to
+the device arrays — same dtypes (int32 counts, so overflow behavior
+matches), same masks (the ``a_svc_ok``/``np_ok``/``av_ok``/``bk_ok``
+predicates of ``dev._ingest_core``), same bucket math
+(``ops.quantile.bucket_index`` float32 twin), and the same murmur3
+hash family for the HLL (``store.archive.sketches``
+``np_hash2_32``/``np_clz32``, seeds 101/202 like ``ops.hll.update``).
+tests/test_query_engine.py gates sketch-tier answers bitwise against
+the device read path.
+
+After a state swap the mirror didn't see (checkpoint restore,
+``adopt_state``) it is marked COLD and lazily resynced from the device
+arrays in one batched fetch (``TpuSpanStore.ensure_sketch_mirror``) —
+exact by construction, since mirror state ≡ device state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from zipkin_tpu.models.constants import FIRST_USER_ANNOTATION_ID
+from zipkin_tpu.ops.hashing import split64
+from zipkin_tpu.store.archive.sketches import (
+    hist_bucket_index,
+    np_clz32,
+    np_hash2_32,
+)
+
+_U32 = np.uint32
+
+
+class SketchDelta(NamedTuple):
+    """One launch unit's aggregate increments in COO form (flat indices
+    into each mirror array; every index is pre-masked — invalid rows
+    are already dropped, mirroring the device's ``where(ok, idx, -1)``
+    scatter convention)."""
+
+    hist_idx: np.ndarray  # flat into svc_hist [S*B]
+    svc_idx: np.ndarray  # into ann_svc_counts [S]
+    name_idx: np.ndarray  # flat into name_presence [S*N]
+    av_idx: np.ndarray  # flat into ann_value_counts [S*A]
+    bk_idx: np.ndarray  # flat into bann_key_counts [S*K]
+    hll_idx: np.ndarray  # HLL register indices
+    hll_rank: np.ndarray  # matching ranks (scatter-max)
+
+
+class SketchMirror:
+    """Host twins of the device's lifetime aggregate arrays (see module
+    docstring). Thread-safe: ``apply`` runs on the commit path,
+    ``adopt`` on a resync, readers on API threads."""
+
+    def __init__(self, config):
+        self.config = config
+        c = config
+        self.gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
+        self._lock = threading.Lock()
+        self._warm = True  # a fresh store's aggregates are all zero
+        S = c.max_services
+        self.svc_hist = np.zeros((S, c.quantile_buckets), np.int32)
+        self.ann_svc_counts = np.zeros(S, np.int32)
+        self.name_presence = np.zeros((S, c.max_span_names), np.int32)
+        self.ann_value_counts = np.zeros(
+            (S, c.max_annotation_values), np.int32)
+        self.bann_key_counts = np.zeros((S, c.max_binary_keys), np.int32)
+        self.hll_traces = np.zeros(1 << c.hll_p, np.int32)
+
+    # -- state ----------------------------------------------------------
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    def mark_cold(self) -> None:
+        """The device state was swapped without a delta (checkpoint
+        restore, adopt_state): the mirror must resync before serving."""
+        with self._lock:
+            self._warm = False
+
+    def adopt(self, svc_hist, ann_svc_counts, name_presence,
+              ann_value_counts, bann_key_counts, hll_traces) -> None:
+        """Resync from already-fetched device arrays. Callers fetch
+        under the store's READ lock (so no commit's delta can be
+        concurrent with the snapshot) and adopt after — a delta from a
+        LATER commit applying after this simply lands on top."""
+        with self._lock:
+            self.svc_hist = np.array(svc_hist, np.int32)
+            self.ann_svc_counts = np.array(ann_svc_counts, np.int32)
+            self.name_presence = np.array(name_presence, np.int32)
+            self.ann_value_counts = np.array(ann_value_counts, np.int32)
+            self.bann_key_counts = np.array(bann_key_counts, np.int32)
+            self.hll_traces = np.array(hll_traces, np.int32)
+            self._warm = True
+
+    # -- write path ------------------------------------------------------
+
+    def delta_of(self, group) -> SketchDelta:
+        """COO delta for one planned launch group (stage 1, host side):
+        ``group`` is the ``_plan_units`` list of (SpanBatch, name_lc,
+        indexable) parts. Pure function — no lock, no device."""
+        c = self.config
+        S = c.max_services
+        hist_parts, svc_parts, name_parts, av_parts, bk_parts = (
+            [], [], [], [], [])
+        hll_i_parts, hll_r_parts = [], []
+        for batch, name_lc, indexable in group:
+            b = batch
+            # Per-service duration histogram (svc_ok in _ingest_core).
+            svc = np.asarray(b.service_id, np.int64)
+            ok = (svc >= 0) & (svc < S) & (b.duration >= 0)
+            if ok.any():
+                bidx = hist_bucket_index(
+                    b.duration[ok], c.quantile_buckets, self.gamma, 1.0)
+                hist_parts.append(svc[ok] * c.quantile_buckets + bidx)
+            # Distinct-trace HLL (seeds 101/202, ops.hll.update).
+            tid = np.asarray(b.trace_id, np.int64)
+            if tid.size:
+                hi, lo = split64(tid)
+                hll_i_parts.append(
+                    (np_hash2_32(hi, lo, 101)
+                     & _U32(self.hll_traces.size - 1)).astype(np.int64))
+                hll_r_parts.append(
+                    (np_clz32(np_hash2_32(hi, lo, 202)) + 1).astype(
+                        np.int32))
+            # Annotation-host aggregates.
+            a_svc = np.asarray(b.ann_service_id, np.int64)
+            a_ok = (a_svc >= 0) & (a_svc < S)
+            if a_ok.any():
+                svc_parts.append(a_svc[a_ok])
+                aidx = b.ann_span_idx
+                # Span-name presence: indexable ann-hosted spans with a
+                # resolved (and representable) name (np_ok).
+                name = np.asarray(b.name_id, np.int64)[aidx]
+                name_lc_a = np.asarray(name_lc, np.int64)[aidx]
+                ixa = np.asarray(indexable, bool)[aidx]
+                np_ok = (a_ok & ixa & (name_lc_a >= 0) & (name >= 0)
+                         & (name < c.max_span_names))
+                if np_ok.any():
+                    name_parts.append(
+                        a_svc[np_ok] * c.max_span_names + name[np_ok])
+                # Top annotations (user annotations only — av_ok).
+                av = np.asarray(b.ann_value_id, np.int64)
+                av_ok = (a_ok & (av >= FIRST_USER_ANNOTATION_ID)
+                         & (av < c.max_annotation_values))
+                if av_ok.any():
+                    av_parts.append(
+                        a_svc[av_ok] * c.max_annotation_values
+                        + av[av_ok])
+            # Top binary keys (bk_ok).
+            bk_svc = np.asarray(b.bann_service_id, np.int64)
+            bk = np.asarray(b.bann_key_id, np.int64)
+            bk_ok = ((bk_svc >= 0) & (bk_svc < S) & (bk >= 0)
+                     & (bk < c.max_binary_keys))
+            if bk_ok.any():
+                bk_parts.append(
+                    bk_svc[bk_ok] * c.max_binary_keys + bk[bk_ok])
+
+        def cat(parts):
+            return (np.concatenate(parts) if parts
+                    else np.zeros(0, np.int64))
+
+        return SketchDelta(
+            cat(hist_parts), cat(svc_parts), cat(name_parts),
+            cat(av_parts), cat(bk_parts), cat(hll_i_parts),
+            (np.concatenate(hll_r_parts) if hll_r_parts
+             else np.zeros(0, np.int32)),
+        )
+
+    def apply(self, delta: SketchDelta) -> None:
+        """Fold one unit's delta in — called from the commit stage
+        INSIDE the store's write-lock hold, immediately before the
+        frontier bump, so sketch-tier reads at frontier F always
+        include every commit ≤ F."""
+        with self._lock:
+            np.add.at(self.svc_hist.reshape(-1), delta.hist_idx,
+                      np.int32(1))
+            np.add.at(self.ann_svc_counts, delta.svc_idx, np.int32(1))
+            np.add.at(self.name_presence.reshape(-1), delta.name_idx,
+                      np.int32(1))
+            np.add.at(self.ann_value_counts.reshape(-1), delta.av_idx,
+                      np.int32(1))
+            np.add.at(self.bann_key_counts.reshape(-1), delta.bk_idx,
+                      np.int32(1))
+            np.maximum.at(self.hll_traces, delta.hll_idx,
+                          delta.hll_rank)
+
+    # -- reads (engine sketch tier) --------------------------------------
+
+    def service_presence(self) -> np.ndarray:
+        with self._lock:
+            return self.ann_svc_counts > 0
+
+    def name_row(self, svc: int) -> np.ndarray:
+        with self._lock:
+            return self.name_presence[svc].copy()
+
+    def hist_row(self, svc: int) -> np.ndarray:
+        with self._lock:
+            return self.svc_hist[svc].copy()
+
+    def ann_value_row(self, svc: int) -> np.ndarray:
+        with self._lock:
+            return self.ann_value_counts[svc].copy()
+
+    def bann_key_row(self, svc: int) -> np.ndarray:
+        with self._lock:
+            return self.bann_key_counts[svc].copy()
+
+    def hll_registers(self) -> np.ndarray:
+        with self._lock:
+            return self.hll_traces.copy()
+
+    def arrays(self) -> Sequence[np.ndarray]:
+        """Snapshot of every mirrored array (conformance tests compare
+        these bitwise against the device state)."""
+        with self._lock:
+            return (self.svc_hist.copy(), self.ann_svc_counts.copy(),
+                    self.name_presence.copy(),
+                    self.ann_value_counts.copy(),
+                    self.bann_key_counts.copy(), self.hll_traces.copy())

@@ -75,7 +75,12 @@ class IngestUnit(NamedTuple):
     is the unit's write-ahead-log sequence (None when no WAL is
     attached); the commit stage advances the store's applied frontier
     to it inside the same write-lock hold as the donating swap, so a
-    checkpoint cut is always consistent with its manifest sequence."""
+    checkpoint cut is always consistent with its manifest sequence.
+    ``sketch`` is the unit's host sketch-mirror delta (store/mirror):
+    computed in stage 1 from the same columns the device scatters,
+    folded into the mirror inside the commit's write-lock hold —
+    the query engine's zero-dispatch tier is never behind the
+    committed frontier."""
 
     db: object
     n_spans: int
@@ -84,6 +89,7 @@ class IngestUnit(NamedTuple):
     n_parts: int
     chained: bool
     wal_seq: Optional[int] = None
+    sketch: Optional[object] = None
 
 
 class _StageBase:

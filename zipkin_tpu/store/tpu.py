@@ -42,6 +42,7 @@ from zipkin_tpu.store.pipeline import (
 )
 from zipkin_tpu.columnar.encode import to_signed64
 from zipkin_tpu.concurrency import RWLock
+from zipkin_tpu.store.mirror import SketchMirror
 from zipkin_tpu.testing.crash import kill_point
 from zipkin_tpu.store.base import (
     IndexedTraceId,
@@ -363,6 +364,16 @@ class TpuSpanStore(SpanStore):
         self.wal = None
         self._wal_applied = 0
         self._wal_marks = None
+        # Host sketch mirror (store/mirror.SketchMirror): numpy twins
+        # of the device's lifetime aggregate arrays, updated by each
+        # commit's delta inside the write-lock hold — the query
+        # engine's zero-dispatch sketch tier (docs/QUERY_ENGINE.md).
+        self.sketch_mirror = SketchMirror(self.config)
+        # Read-visibility epoch: bumped by host-side state that changes
+        # query answers WITHOUT a device commit (pin/TTL mutations,
+        # pin-bank arrivals). write_frontier() = (_step_seq, epoch) is
+        # the result-cache key component.
+        self._read_epoch = 0
         # Pending-sweep pacing: sweep every SWEEP_EVERY batches on the
         # write path (bounds how long a cross-batch child waits for its
         # link) and lazily before dependency reads — but only when
@@ -457,6 +468,10 @@ class TpuSpanStore(SpanStore):
         with self._lock:
             for span in spans:
                 self.ttls.setdefault(to_signed64(span.trace_id), 1.0)
+            if self.pins:
+                # Pin-bank arrivals change read answers before the
+                # commit bumps the frontier — invalidate cached reads.
+                self._bump_read_epoch()
             self.pins.note_write(to_signed64, spans)
             self._prune_ttls()
             # Chunking keeps jit shapes bounded and batches under ring
@@ -602,6 +617,7 @@ class TpuSpanStore(SpanStore):
                 )
                 if keep.any():
                     pinned_part = self._select_batch(batch, keep)
+                    self._bump_read_epoch()
                     self.pins.note_write(
                         to_signed64, self.codec.decode(pinned_part)
                     )
@@ -816,6 +832,7 @@ class TpuSpanStore(SpanStore):
         max and stack along a leading scan axis. pow2 bucketing bounds
         the jit compile cache, so a warmed steady state pads into
         already-compiled shapes only (dev.compile_count gates this)."""
+        sketch = self.sketch_mirror.delta_of(group)
         if len(group) == 1:
             b, lc, ix = group[0]
             db = dev.make_device_batch(
@@ -825,7 +842,7 @@ class TpuSpanStore(SpanStore):
                 pad_banns=_next_pow2(b.n_binary),
             )
             return IngestUnit(db, b.n_spans, b.n_annotations,
-                              b.n_binary, 1, False)
+                              b.n_binary, 1, False, sketch=sketch)
         pad_s = _next_pow2(max(b.n_spans for b, _, _ in group))
         pad_a = _next_pow2(max(b.n_annotations for b, _, _ in group))
         pad_b = _next_pow2(max(b.n_binary for b, _, _ in group))
@@ -841,7 +858,7 @@ class TpuSpanStore(SpanStore):
             sum(b.n_spans for b, _, _ in group),
             sum(b.n_annotations for b, _, _ in group),
             sum(b.n_binary for b, _, _ in group),
-            len(group), True,
+            len(group), True, sketch=sketch,
         )
 
     def _commit_unit(self, unit: IngestUnit) -> None:
@@ -863,6 +880,10 @@ class TpuSpanStore(SpanStore):
         # deterministic replay (wal/recovery) rebuilds launches from.
         with self._rw.write():
             self.state = step(self.state, unit.db)
+            # Mirror BEFORE the frontier bump: a sketch-tier read at
+            # frontier F must already include commit F's delta.
+            if unit.sketch is not None:
+                self.sketch_mirror.apply(unit.sketch)
             self._wp += unit.n_spans
             self._awp += unit.n_anns
             self._bwp += unit.n_banns
@@ -1116,6 +1137,9 @@ class TpuSpanStore(SpanStore):
         self._cap_upto = self._wp
         self._cap_a = self._cap_b = 0
         self._sealed_upto = self._cap_upto
+        # The adopted state's aggregates were built outside the write
+        # path: resync the sketch mirror lazily from the device.
+        self.sketch_mirror.mark_cold()
 
     # -- durable write-ahead log (zipkin_tpu.wal) -----------------------
 
@@ -1233,16 +1257,52 @@ class TpuSpanStore(SpanStore):
         tid = to_signed64(trace_id)
         with self._lock:
             self.ttls[tid] = ttl_seconds
+            self._bump_read_epoch()
             pin = ttl_seconds > self.DEFAULT_TTL_S
             if not pin:
                 self.pins.unpin(tid)
         if pin:
             fill_pin(self.pins, self._lock, tid, lambda: (
                 self.get_spans_by_trace_ids([trace_id]) or [[]])[0])
+            with self._lock:
+                self._bump_read_epoch()  # bank filled: reads widened
 
     def get_time_to_live(self, trace_id: int) -> float:
         with self._lock:
             return self.ttls[to_signed64(trace_id)]
+
+    # -- query-engine hooks (query/engine.py) ---------------------------
+
+    def write_frontier(self) -> Tuple[int, int]:
+        """Monotonic host-mirrored commit frontier — the result-cache
+        key component. (_step_seq advances inside every donating
+        write-lock hold: ingest commits, sweeps, bucket closes, state
+        adoption — so ring eviction is a frontier advance too;
+        _read_epoch covers host-only visibility changes: pin/TTL
+        mutations and pin-bank arrivals.) No device traffic."""
+        return (self._step_seq, self._read_epoch)
+
+    def _bump_read_epoch(self) -> None:
+        self._read_epoch += 1
+
+    def ensure_sketch_mirror(self) -> SketchMirror:
+        """The sketch mirror, resynced from the device aggregates if a
+        state swap left it cold (checkpoint restore, adopt_state) —
+        ONE batched D2H, after which incremental deltas keep it warm
+        with zero device traffic. Lock order: _rw.read THEN the
+        mirror's lock (the commit path takes _rw.write then the
+        mirror's lock — same order, no inversion)."""
+        m = self.sketch_mirror
+        if not m.warm:
+            with self._rw.read():
+                st = self.state
+                host = jax.device_get((
+                    st.svc_hist, st.ann_svc_counts, st.name_presence,
+                    st.ann_value_counts, st.bann_key_counts,
+                    st.hll_traces,
+                ))
+                m.adopt(*host)
+        return m
 
     # -- id lookups -----------------------------------------------------
 
@@ -1788,6 +1848,10 @@ class TpuSpanStore(SpanStore):
         # pipelined steady state must hold this flat (bench_smoke's
         # pipeline phase gates the delta at zero).
         out["jit_compiles"] = float(dev.compile_count())
+        # The resident query programs' twin counter: flat in steady
+        # state (every dispatch hits a compiled variant) — the query
+        # engine's "zero steady-state recompiles" observable.
+        out["query_jit_compiles"] = float(dev.query_compile_count())
         p = self._pipeline
         if p is not None:
             out["pipeline_prefetch_depth"] = float(p.queued())

@@ -67,6 +67,7 @@ def replay_into(store, wal, from_seq: Optional[int] = None) -> dict:
                     keep = np.isin(batch.trace_id, pin_tids)
                     if keep.any():
                         pinned = hot._select_batch(batch, keep)
+                        hot._bump_read_epoch()
                         hot.pins.note_write(
                             to_signed64, hot.codec.decode(pinned))
             hot._prune_ttls()
