@@ -125,7 +125,8 @@ def bench_sql_baseline(total_spans: int = 10_000):
 # ---------------------------------------------------------------------------
 
 
-def _tpu_config(capacity_log2: int, n_services: int, use_pallas: bool):
+def _tpu_config(capacity_log2: int, n_services: int, use_pallas: bool,
+                rank_path: str = "auto"):
     from zipkin_tpu.store import device as dev
 
     # Index sizing for the benchmark's UNIFORM key space (1k services x
@@ -152,6 +153,7 @@ def _tpu_config(capacity_log2: int, n_services: int, use_pallas: bool):
         hll_p=14,
         quantile_buckets=2048,
         use_pallas=use_pallas,
+        rank_path=rank_path,
         idx_name_buckets=(1 << 16) if big else 0,
         idx_name_depth=256 if big else 0,
         # ~4x the live key count: the i32-fingerprint claims (probes=3)
@@ -310,7 +312,7 @@ def _telemetry_block(store) -> dict:
 
 def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
                      n_services: int = 1024, batch_traces: int = 16384,
-                     use_pallas: bool = False):
+                     use_pallas: bool = False, rank_path: str = "auto"):
     """Stream ``total_spans`` through the fused ingest (config #2) and
     return (store-with-final-state, ingest stats)."""
     import jax
@@ -319,7 +321,8 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
     from zipkin_tpu.store import device as dev
     from zipkin_tpu.store.tpu import TpuSpanStore
 
-    config = _tpu_config(capacity_log2, n_services, use_pallas)
+    config = _tpu_config(capacity_log2, n_services, use_pallas,
+                         rank_path)
     store = TpuSpanStore(config)
     cap = config.capacity
     # One launch must never outrun the archive cadence (one dependency-
@@ -406,6 +409,12 @@ def bench_tpu_stream(total_spans: int, capacity_log2: int = 22,
         "chain": chain,
         "archive_runs": archive_runs,
         "use_pallas": use_pallas,
+        # Active kernel paths (r12): which rank / arena-scatter
+        # implementations the compiled steps took — "auto"/"counting"
+        # degrade statically (wm_shift == 0, scratch budget, VMEM
+        # fit), so the record must say what actually ran.
+        "rank_path": dev.active_paths(config).get("rank", ()),
+        "scatter_path": dev.active_paths(config).get("scatter", ()),
         # Per-stage telemetry: the device counter block (one fused
         # fetch — ring occupancy/laps, poison census, ingest counters)
         # rides the BENCH json so remote runs surface the same
@@ -1295,6 +1304,82 @@ def bench_compare_kernels(total_spans: int = 10_000_000):
     return out
 
 
+def bench_ingest_matrix(spans_per_arm: int, smoke: bool = False):
+    """Ingest-roofline round-2 evidence (r12): spans/s per
+    (batch_spans, sort-path, scatter-path) arm, so the next on-chip
+    run can pick the batch-escalation knee and certify the >=300k
+    spans/s single-chip gate at the 100M config with the kernel
+    choices named in the record.
+
+    Three arm families, each a short fused-ingest stream:
+
+    - **batch escalation** at the cert geometry (cap 2^22): sweep the
+      template batch through {0.5x, 1x, 2x, 4x} of the r5-era 114688-
+      span optimum — the PR 4 pipeline removed the host stalls that
+      set it, so the scatter-amortization knee must be re-measured;
+    - **sort path** at a mid geometry (cap 2^16, batch_traces=512 →
+      ~3.6k spans ≈ ~57k concatenated index ROWS per launch) where
+      the counting-rank scratch fits: argsort vs counting, same
+      stream (at the cert geometry counting statically degrades to
+      argsort — the scratch arithmetic in docs/PERFORMANCE.md — so
+      the comparison is only measurable here);
+    - **scatter path** at a small geometry (cap 2^12) where the
+      unified arena fits VMEM: XLA plane scatters vs the fused pallas
+      claim+scatter kernel (ops/pallas_kernels.arena_claim_scatter).
+
+    Every arm records the ACTIVE paths (dev.active_paths), not just
+    the requested ones — "auto"/"counting"/pallas degrade statically
+    and the record must say what ran."""
+    if smoke:
+        arms = [
+            dict(capacity_log2=14, n_services=64, batch_traces=256,
+                 rank_path="argsort"),
+            dict(capacity_log2=14, n_services=64, batch_traces=256,
+                 rank_path="counting"),
+            dict(capacity_log2=12, n_services=64, batch_traces=128,
+                 use_pallas=True),
+        ]
+    else:
+        arms = [
+            # (a) batch escalation at the cert geometry.
+            dict(batch_traces=8192),
+            dict(batch_traces=16384),
+            dict(batch_traces=32768),
+            dict(batch_traces=65536),
+            # (b) sort path, mid geometry (counting engages here).
+            dict(capacity_log2=16, n_services=64, batch_traces=512,
+                 rank_path="argsort"),
+            dict(capacity_log2=16, n_services=64, batch_traces=512,
+                 rank_path="counting"),
+            # (c) scatter path, VMEM-resident arena geometry.
+            dict(capacity_log2=12, n_services=64, batch_traces=128),
+            dict(capacity_log2=12, n_services=64, batch_traces=128,
+                 use_pallas=True),
+        ]
+    out = []
+    for arm in arms:
+        label = ",".join(f"{k}={v}" for k, v in sorted(arm.items()))
+        try:
+            store, stats = bench_tpu_stream(spans_per_arm, **arm)
+            store = None  # free HBM before the next arm compiles
+            out.append({
+                "arm": arm,
+                "batch_spans": stats["batch_spans"],
+                "spans_per_s": stats["spans_per_s"],
+                "rank_path": stats["rank_path"],
+                "scatter_path": stats["scatter_path"],
+                "chain": stats["chain"],
+            })
+            _log(f"matrix arm [{label}]: "
+                 f"{stats['spans_per_s'] / 1e3:.1f}k spans/s "
+                 f"(rank={stats['rank_path']}, "
+                 f"scatter={stats['scatter_path']})")
+        except Exception as e:  # noqa: BLE001 — one arm, not the phase
+            out.append({"arm": arm, "error": repr(e)})
+            _log(f"matrix arm [{label}] failed: {e!r}")
+    return out
+
+
 def _make_emitter(detail, get_ingest, get_sql):
     """The one-line JSON record, emitted INCREMENTALLY: printed+flushed
     after every completed phase (and mirrored to BENCH_PARTIAL.json), so
@@ -1345,6 +1430,26 @@ def main():
                     help="traces per template batch in the full config "
                          "(x7 spans; larger batches shrink the per-scan-"
                          "iteration floor share — tune on real hardware)")
+    ap.add_argument("--batch-spans", type=int, default=0,
+                    help="batch escalation: template batch size in "
+                         "SPANS (overrides --batch-traces, rounded "
+                         "down to whole traces; the half-ring guard "
+                         "still clamps — see bench_ingest_matrix for "
+                         "the sweep that picks the knee)")
+    ap.add_argument("--use-pallas", action="store_true",
+                    help="route the main stream through the pallas "
+                         "kernels (histogram adds always; the fused "
+                         "arena claim+scatter when the arena fits "
+                         "VMEM — the record says which path ran)")
+    ap.add_argument("--rank-path", default="auto",
+                    choices=("auto", "argsort", "counting"),
+                    help="index-write FIFO rank implementation for "
+                         "the main stream (bitwise-identical paths; "
+                         "counting degrades to argsort where its "
+                         "scratch can't fit — recorded either way)")
+    ap.add_argument("--no-ingest-matrix", action="store_true",
+                    help="skip the (batch_spans, sort-path, scatter-"
+                         "path) arm matrix phase")
     ap.add_argument("--pipeline-depth", type=int, default=8,
                     help="prefetch depth for the pipelined-ingest "
                          "phase (bounded stage-1 queue)")
@@ -1397,14 +1502,18 @@ def main():
     ingest = None
     emit = _make_emitter(detail, lambda: ingest, lambda: sql)
     try:
+        batch_traces = (max(1, args.batch_spans // SPT)
+                        if args.batch_spans > 0 else args.batch_traces)
         if args.smoke:
             store, ingest = bench_tpu_stream(
                 int(args.spans or 2e5), capacity_log2=16, n_services=64,
-                batch_traces=min(args.batch_traces, 1024),
+                batch_traces=min(batch_traces, 1024),
+                use_pallas=args.use_pallas, rank_path=args.rank_path,
             )
         else:
             store, ingest = bench_tpu_stream(
-                int(args.spans or 1e8), batch_traces=args.batch_traces
+                int(args.spans or 1e8), batch_traces=batch_traces,
+                use_pallas=args.use_pallas, rank_path=args.rank_path,
             )
         detail["config2_tpu_ingest"] = ingest
         emit("stream")
@@ -1460,6 +1569,19 @@ def main():
                 int(2e4) if args.smoke else int(2e5)),
             timeout_s=900, label="durability")
         emit("stream+queries+exactness+archive+pipeline+durability")
+        # Ingest roofline round 2 (r12 tentpole): spans/s per
+        # (batch_spans, sort-path, scatter-path) arm — the evidence
+        # the batch-escalation knee and the >=300k spans/s cert read
+        # from. Short per-arm streams, bounded, after the core emits
+        # (the r4 lesson: never let an extra-credit phase strand the
+        # headline record).
+        if not args.no_ingest_matrix:
+            detail["ingest_matrix"] = _bounded(
+                lambda: bench_ingest_matrix(
+                    int(1e5) if args.smoke else int(1e7),
+                    smoke=args.smoke),
+                timeout_s=2400, label="ingest-matrix")
+            emit("core+matrix")
         # The XLA-vs-pallas kernel decision was measured and recorded in
         # round 4 (xla 158.6k vs pallas 155.0k spans/s, NOTES_r04 §3);
         # re-measuring it on every full run cost two extra compile+

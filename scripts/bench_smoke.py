@@ -36,9 +36,10 @@ def _count_ops(stablehlo_text: str) -> dict:
     and exactly the structural quantity the unified-arena work drove
     down: how many scatter/sort ops the ingest step ISSUES per batch.
     r5 split-design baseline at the smoke shapes: 101 scatters /
-    6 sorts / 80 gathers; the r6 unified arena ships 95 / 5 / 79 (and
-    moves the exact candidate-ts watermark war behind a lax.cond that
-    real traffic never executes). One shared counter (dev.
+    6 sorts / 80 gathers; the r6 unified arena shipped 95 / 5 / 79;
+    the r12 counting-sort rank path ships 95 / 4 / 79 (ceilings
+    centralized in zipkin_tpu.store.census — the one place the tier-1
+    gate reads them from). One shared counter (dev.
     stablehlo_op_census) backs this gate AND the runtime
     TpuSpanStore.step_census observable, so they can never drift."""
     from zipkin_tpu.store.device import stablehlo_op_census
@@ -526,6 +527,157 @@ def run_query() -> dict:
     }
 
 
+def run_ingest_structure() -> dict:
+    """Ingest-roofline phase (r12 tentpole): the three structural
+    claims behind the batch-escalation / counting-sort / pallas work,
+    proven on every CI run:
+
+    (a) the counting-sort rank path's fused-step lowering carries
+        strictly fewer stablehlo.sort ops than the argsort path's (the
+        portable proxy for the deleted O(N log N) entry cost) while
+        issuing no extra scatters/gathers — store-level bitwise
+        identity between the two paths is the fuzz suite's job
+        (tests/test_rank_paths.py), not re-driven here;
+    (b) a batch-escalated geometry (StoreConfig.batch_spans) driven
+        through the three-stage pipeline performs ZERO steady-state
+        jit recompiles once warmed — escalation changes pad buckets,
+        not compile-cache churn;
+    (c) the stage-1 sketch-mirror COO delta (riding the hot encode
+        path since r11) adds at most MAX_MIRROR_DELTA_RATIO to the
+        encode stage, measured as paired per-round ratios (min over
+        rounds — the WAL phase's noise discipline)."""
+    import numpy as np
+
+    from zipkin_tpu.store import census, device as dev
+    from zipkin_tpu.store.base import should_index
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+    from zipkin_tpu.columnar.schema import SpanBatch
+
+    base = dict(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=128, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=512,
+    )
+    cfg_arg = dev.StoreConfig(**base, rank_path="argsort",
+                              batch_spans=256)
+    cfg_cnt = dev.StoreConfig(**base, rank_path="counting",
+                              batch_spans=256)
+    # The escalated batch geometry: same store, bigger launches (the
+    # ring guards clamp at capacity//2 = 512 — this IS the escalation
+    # ceiling for the smoke ring).
+    cfg_big = dev.StoreConfig(**base, rank_path="counting",
+                              batch_spans=512)
+    traces = generate_traces(n_traces=440, max_depth=3, n_services=16)
+    spans = [s for t in traces for s in t][:1280]
+
+    def drive(store, slice_spans=512):
+        for i in range(0, len(spans), slice_spans):
+            store.apply(spans[i:i + slice_spans])
+        return store
+
+    # Per-path census: lowering only — the trace also records each
+    # config's active rank path (dev.active_paths), no drive needed.
+    db = dev.make_device_batch(
+        SpanBatch.empty(0, 0, 0), name_lc_id=np.zeros(0, np.int32),
+        indexable=np.zeros(0, bool),
+        pad_spans=256, pad_anns=1024, pad_banns=512,
+    )
+    census_arg = _count_ops(
+        dev.ingest_step.lower(dev.init_state(cfg_arg), db).as_text())
+    census_cnt = _count_ops(
+        dev.ingest_step.lower(dev.init_state(cfg_cnt), db).as_text())
+
+    # Batch escalation through the pipeline: warm the escalated
+    # geometry end-to-end (staged device args key their own jit rows),
+    # then gate steady-state recompiles at ZERO across a fresh
+    # pipelined drive of the same geometry.
+    warm = TpuSpanStore(cfg_big)
+    warm.start_pipeline(4)
+    drive(warm)
+    warm.drain_pipeline()
+    warm.stop_pipeline()
+    meas = TpuSpanStore(cfg_big)
+    compiles0 = dev.compile_count()
+    meas.start_pipeline(4)
+    t0 = time.perf_counter()
+    drive(meas)
+    meas.drain_pipeline()
+    escalated_s = time.perf_counter() - t0
+    recompiles = dev.compile_count() - compiles0
+    meas.stop_pipeline()
+    c_meas = meas.counters()
+    warm.close()
+    meas.close()
+
+    # Sketch-mirror stage-1 cost: paired encode-vs-delta rounds over
+    # the SAME launch groups (host-only — no device work — so the
+    # probe uses a bigger span set than the drives: per-group fixed
+    # delta costs then sit against a steady-state encode denominator
+    # instead of dominating a tiny one). The first pass warms the
+    # dictionaries; measured rounds are steady-state re-encodes.
+    # cfg_big's 512-span chunks: the deployment geometry the delta
+    # actually rides at (bigger launches amortize its per-group fixed
+    # cost — measuring at tiny chunks would overstate it).
+    probe = TpuSpanStore(cfg_big)
+    m_traces = generate_traces(n_traces=900, max_depth=3,
+                               n_services=16)
+    m_spans = [s for t in m_traces for s in t][:2560]
+
+    def encode_parts():
+        parts = []
+        for part in probe._chunk_by_trace(m_spans):
+            batch = probe.codec.encode(part)
+            indexable = np.fromiter(
+                (should_index(s) for s in part), bool, len(part))
+            name_lc = probe._name_lc_ids(batch)
+            parts.extend(probe._chunk_columnar(batch, name_lc,
+                                               indexable))
+        return parts
+
+    groups = list(probe._plan_units(encode_parts()))  # warm dicts
+    ratios, enc_ms, delta_ms = [], [], []
+    for _ in range(3):
+        # The FULL stage-1 body writers pay (encode + index bits +
+        # chunking + pow2 padding + the mirror delta, exactly what
+        # _apply_pipelined runs under the encode lock)...
+        t0 = time.perf_counter()
+        groups = list(probe._plan_units(encode_parts()))
+        for g in groups:
+            probe._pad_unit(g)  # includes delta_of
+        stage_s = time.perf_counter() - t0
+        # ...vs the delta alone; ratio = delta / stage-without-delta.
+        t0 = time.perf_counter()
+        for g in groups:
+            probe.sketch_mirror.delta_of(g)
+        d_s = time.perf_counter() - t0
+        ratios.append(d_s / max(stage_s - d_s, 1e-9))
+        enc_ms.append((stage_s - d_s) * 1e3)
+        delta_ms.append(d_s * 1e3)
+    probe.close()
+    return {
+        "spans": len(spans),
+        "census_argsort": census_arg,
+        "census_counting": census_cnt,
+        "rank_path_argsort_cfg": dev.active_paths(cfg_arg).get(
+            "rank", ()),
+        "rank_path_counting_cfg": dev.active_paths(cfg_cnt).get(
+            "rank", ()),
+        "rank_path_counting": c_meas["rank_path_counting"],
+        "scatter_path_pallas": c_meas["scatter_path_pallas"],
+        "batch_spans_geometries": [cfg_cnt.batch_spans,
+                                   cfg_big.batch_spans],
+        "escalated_batch_spans_limit": c_meas["batch_spans_limit"],
+        "recompiles_after_batch_escalation": int(recompiles),
+        "escalated_pipelined_s": round(escalated_s, 3),
+        "mirror_delta_ratio": round(min(ratios), 4),
+        "mirror_delta_ms": round(min(delta_ms), 2),
+        "encode_ms": round(min(enc_ms), 2),
+        "mirror_budget": census.MAX_MIRROR_DELTA_RATIO,
+    }
+
+
 def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
     import numpy as np  # noqa: F401  (kept: smoke envs import-check it)
 
@@ -538,6 +690,11 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         max_services=64, max_span_names=128, max_annotation_values=512,
         max_binary_keys=128, cms_width=1 << 12, hll_p=8,
         quantile_buckets=512,
+        # Pin the counting rank path: the op-count gate below is the
+        # COUNTING path's census (95/4/79 ceilings). "auto" would pick
+        # argsort on the CPU CI backend (backend-aware policy,
+        # dev.rank_mode) and gate the wrong lowering.
+        rank_path="counting",
     )
     store = TpuSpanStore(config)
     gen = ColumnarTraceGen(store.dicts, n_services=32, n_span_names=64,
@@ -626,12 +783,20 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
                                        "p99") and v == v else v)
         for k, v in step_sketch.snapshot().items()
     }
+    from zipkin_tpu.store import census
+
     return {
         "metric": "bench_smoke",
         "archive": run_archive(),
         "pipeline": run_pipeline(),
         "wal": run_wal(),
         "query": run_query(),
+        "ingest_structure": run_ingest_structure(),
+        "census_ceilings": {
+            "scatter": census.MAX_STEP_SCATTERS,
+            "sort": census.MAX_STEP_SORTS,
+            "gather": census.MAX_STEP_GATHERS,
+        },
         "spans": total,
         "ingest_spans_per_s": round(total / dt, 1),
         "ingest_ms_per_batch": round(dt / len(dbs) * 1e3, 2),

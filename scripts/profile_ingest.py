@@ -25,6 +25,11 @@ def main():
     ap.add_argument("--cap-log2", type=int, default=22)
     ap.add_argument("--traces", type=int, default=16384)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--batch-spans-sweep", default="",
+                    help="comma-separated span counts: re-template the "
+                         "full step at each batch size and time it "
+                         "(the r12 batch-escalation knee finder, e.g. "
+                         "57344,114688,229376,458752)")
     args = ap.parse_args()
 
     import jax
@@ -323,6 +328,103 @@ def main():
         ).sum()
 
     timeit("war_max64 full width", jax.jit(war_only), wmv, bkt)
+
+    # 8c. r12 rank-path arms: the argsort rank vs the segmented
+    # counting rank at the step's REAL concatenated shape + bucket
+    # count. Counting is scratch-bounded — when no block fits at this
+    # geometry the arm reports so (the step then statically keeps
+    # argsort; see device.rank_block_for / docs/PERFORMANCE.md).
+    n_b_total = config.idx_layout[1]
+    rbkt = ((jnp.arange(NR, dtype=jnp.int64) * 2654435761)
+            % n_b_total).astype(jnp.int32)
+    rvalid = jnp.ones(NR, bool)
+
+    def arg_ranks(bb):
+        return dev._fifo_ranks(bb, rvalid, n_b_total).sum()
+
+    timeit(f"rank path: argsort ({NR} rows, {n_b_total} buckets)",
+           jax.jit(arg_ranks), rbkt)
+    blk = dev.rank_block_for(NR, n_b_total)
+    if blk:
+        def cnt_ranks(bb):
+            return dev._fifo_ranks_counting(bb, rvalid, n_b_total,
+                                            blk).sum()
+
+        timeit(f"rank path: counting (block {blk})",
+               jax.jit(cnt_ranks), rbkt)
+    else:
+        print(f"rank path: counting infeasible at {NR} rows x "
+              f"{n_b_total} buckets (scratch budget); step keeps "
+              "argsort here", flush=True)
+
+    # 8d. r12 arena-scatter arms: the 6-plane XLA scatter vs the fused
+    # pallas claim+scatter, at a geometry whose arena fits VMEM (the
+    # kernel's own support boundary — the full-size arena stays on the
+    # XLA path by the NOTES_r06 §3 roofline).
+    from zipkin_tpu.ops import pallas_kernels as PK
+
+    small_nb, small_depth = 1 << 10, 32
+    small_S = small_nb * small_depth
+    if PK.arena_scatter_supported(small_S, small_nb):
+        NS = min(NR, 1 << 17)
+        ent = jnp.zeros((small_S, 3), jnp.int64)
+        sb = ((jnp.arange(NS, dtype=jnp.int64) * 2654435761)
+              % small_nb).astype(jnp.int32)
+        svals = jnp.stack([jnp.arange(NS, dtype=jnp.int64)] * 3, -1)
+        sval = jnp.ones(NS, bool)
+        sbase = jnp.zeros(NS, jnp.int32)
+        sslot0 = sb.astype(jnp.int64) * small_depth
+        sdep = jnp.full(NS, small_depth, jnp.int32)
+
+        def xla_scatter(e):
+            rank = dev._fifo_ranks(sb, sval, small_nb)
+            slot = sslot0.astype(jnp.int32) + (rank % small_depth)
+            keep = sval & (rank >= 0)
+            return dev._uset_cols64(e, slot, svals, keep).sum()
+
+        def pallas_scatter(e):
+            return PK.arena_claim_scatter(
+                e, sb, sbase, sslot0, sdep, svals, sval,
+                n_buckets=small_nb).sum()
+
+        timeit(f"arena scatter: XLA rank+6-plane ({NS} rows)",
+               jax.jit(xla_scatter), ent)
+        timeit("arena scatter: pallas claim+scatter (VMEM arena)",
+               jax.jit(pallas_scatter), ent)
+
+    # 9a. r12 batch escalation: re-template the full step at each
+    # requested batch size and time it — spans/s per batch_spans is
+    # the scatter-amortization curve whose knee picks the new
+    # StoreConfig.batch_spans / bench --batch-spans default (the old
+    # 16384-trace optimum predates the PR 4 pipeline overlap).
+    sweep = [int(x) for x in args.batch_spans_sweep.split(",") if x]
+    from bench import SPT
+
+    for bs in sweep:
+        traces_n = max(1, bs // SPT)
+        if traces_n * SPT > (1 << args.cap_log2) // 2:
+            print(f"batch_spans {bs}: exceeds half-ring budget at "
+                  f"cap 2^{args.cap_log2}; skipped", flush=True)
+            continue
+        db_s, _, pad_s = _make_template(store, 1024, traces_n)
+        st_s = jax.device_put(dev.init_state(config))
+        step_s = jax.jit(
+            lambda s, d: dev.ingest_step.__wrapped__(s, d))
+        t0 = time.perf_counter()
+        out_s = step_s(st_s, db_s)
+        jax.device_get(out_s.counters["spans_seen"])
+        t1 = time.perf_counter()
+        times = []
+        for _ in range(args.reps):
+            t2 = time.perf_counter()
+            out_s = step_s(st_s, db_s)
+            jax.device_get(out_s.counters["spans_seen"])
+            times.append(time.perf_counter() - t2)
+        best = min(times)
+        print(f"batch_spans {pad_s:7d}: compile+1st {t1 - t0:7.3f}s  "
+              f"steady {best * 1e3:9.1f} ms  "
+              f"({pad_s / best / 1e3:8.1f}k spans/s)", flush=True)
+        del st_s, out_s, db_s
 
     # 9. chain scaling: is scan amortization working?
     for k in (1, 4, 18):

@@ -3,32 +3,32 @@ ingest step's scatter/sort op counts must not regress.
 
 Per-kernel overhead dominates the target device class (NOTES_r03 §3);
 the r6 unified index arena exists to cut scatter/sort launches per
-batch. These ceilings are the measured post-merge counts at the smoke
-shapes — if a change pushes past them, it re-grew the very block the
-tentpole collapsed (raise them only with a NOTES entry explaining what
+batch, and the r12 counting-sort rank path deleted the last hot-path
+sort. The ceilings live in ONE place — ``zipkin_tpu.store.census`` —
+consumed here and by the smoke script, so a path change updates
+exactly one number (raise one only with a NOTES entry explaining what
 bought the extra launches). r5 split-design baseline: 101 scatters /
-6 sorts.
+6 sorts / 80 gathers; r6: 95/5/79; r12: 95/4/79.
 """
 
 import json
 import subprocess
 import sys
 
-# Measured at the bench_smoke shapes on the unified-arena step
-# (StableHLO census, backend-independent). The r5 split design sat at
-# 101/6/80; r6 ships 95/5/79 and the r8 cold tier must keep it there —
-# eviction capture is a SEPARATE read-only launch, never ops inside
-# the fused step.
-MAX_STEP_SCATTERS = 95
-MAX_STEP_SORTS = 5
-MAX_STEP_GATHERS = 79
+from zipkin_tpu.store.census import (
+    ARGSORT_STEP_SORTS,
+    MAX_MIRROR_DELTA_RATIO,
+    MAX_STEP_GATHERS,
+    MAX_STEP_SCATTERS,
+    MAX_STEP_SORTS,
+)
 
 
 def test_bench_smoke_json_and_op_ceilings():
     proc = subprocess.run(
         [sys.executable, "scripts/bench_smoke.py", "--spans", "2000",
          "--k", "4"],
-        capture_output=True, text=True, timeout=540,
+        capture_output=True, text=True, timeout=780,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     line = proc.stdout.strip().splitlines()[-1]
@@ -128,3 +128,32 @@ def test_bench_smoke_json_and_op_ceilings():
     assert q["cache_invalidation_exact"] is True, q
     assert q["cache_hits"] >= 1 and q["sketch_answers"] >= 1, q
     assert 0 < q["index_p99_ms"] < 250.0, q
+    # Ingest-structure phase (r12 tentpole): the counting-sort rank
+    # path must lower with strictly fewer sorts than the argsort path
+    # (the deleted O(N log N) entry cost, structurally — store-level
+    # bitwise identity between the paths is fuzz-gated in
+    # tests/test_rank_paths.py); a batch-escalated geometry must
+    # perform ZERO steady-state recompiles through the pipeline once
+    # warmed; and the stage-1 sketch-mirror COO delta must stay
+    # inside its encode-stage budget (it rides the hot path since r11
+    # and nothing watched it until now).
+    ing = rec["ingest_structure"]
+    assert ing["rank_path_counting_cfg"] == ["counting"], ing
+    assert ing["rank_path_argsort_cfg"] == ["argsort"], ing
+    assert ing["census_counting"]["sort"] < MAX_STEP_SORTS + 1, ing
+    assert ing["census_counting"]["sort"] < ARGSORT_STEP_SORTS, ing
+    assert ing["census_argsort"]["sort"] <= ARGSORT_STEP_SORTS, ing
+    assert (ing["census_counting"]["scatter"]
+            <= ing["census_argsort"]["scatter"]), ing
+    assert (ing["census_counting"]["gather"]
+            <= ing["census_argsort"]["gather"]), ing
+    assert ing["rank_path_counting"] == 1.0, ing
+    assert ing["recompiles_after_batch_escalation"] == 0, ing
+    assert ing["escalated_batch_spans_limit"] == 512.0, ing
+    assert ing["mirror_delta_ratio"] <= MAX_MIRROR_DELTA_RATIO, ing
+    # The ceilings the smoke JSON carries must be the census module's
+    # (one definition site — this test would catch a re-hard-coding).
+    assert rec["census_ceilings"] == {
+        "scatter": MAX_STEP_SCATTERS, "sort": MAX_STEP_SORTS,
+        "gather": MAX_STEP_GATHERS,
+    }

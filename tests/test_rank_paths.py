@@ -1,0 +1,213 @@
+"""r12 fuzz equivalence suite: the counting-sort and argsort FIFO-rank
+paths must be BITWISE interchangeable.
+
+The unified index write (_index_write) derives every arena mutation —
+slot assignment, in-batch overflow drops, displacement bookkeeping,
+key claims, watermark wars — from the within-bucket arrival rank, so
+the two rank implementations being bitwise-identical is what makes
+``StoreConfig.rank_path`` pure perf policy (mixable across launches,
+checkpoints, and replay). This suite fuzzes the rank vectors directly
+across the adversarial bucket shapes (duplicate-heavy, empty-bucket,
+all-one-bucket, ragged tails) and proves whole-store arena-state
+identity on real ingest workloads, plus the wm_shift == 0 small-store
+regime's static argsort fallback.
+
+Tier-1 discipline: the rank-VECTOR fuzz (cheap, eager, covers every
+adversarial bucket class) and ONE whole-store drive pair run in the
+fast lane; the remaining whole-store twins and the large-batch
+escalated sweep ride the slow lane (one tiny config pair shared
+across every state case, so the jit cache is paid once).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from zipkin_tpu.store import device as dev
+from zipkin_tpu.store.tpu import TpuSpanStore
+from zipkin_tpu.testing.crash import states_bitwise_equal
+from zipkin_tpu.tracegen import generate_traces
+
+BASE = dict(
+    capacity=1 << 10, ann_capacity=1 << 11, bann_capacity=1 << 10,
+    max_services=16, max_span_names=32, max_annotation_values=64,
+    max_binary_keys=32, cms_width=1 << 8, hll_p=6, quantile_buckets=64,
+)
+CFG_ARG = dev.StoreConfig(**BASE, rank_path="argsort")
+CFG_CNT = dev.StoreConfig(**BASE, rank_path="counting")
+
+
+def _assert_rank_pair(bucket, valid, n_buckets, blocks=(8, 16, 64)):
+    want = np.asarray(dev._fifo_ranks(bucket, valid, n_buckets))
+    for blk in blocks:
+        got = np.asarray(
+            dev._fifo_ranks_counting(bucket, valid, n_buckets, blk))
+        np.testing.assert_array_equal(want, got, err_msg=f"block {blk}")
+
+
+class TestRankVectorEquivalence:
+    def test_fuzz_random_shapes(self):
+        rng = np.random.default_rng(7)
+        # (rows, buckets): duplicate-heavy (few buckets), sparse (more
+        # buckets than rows => most empty), ragged non-pow2 tails,
+        # single-row.
+        for n, nb in [(513, 3), (256, 2), (1000, 4096), (97, 13),
+                      (1, 5), (64, 64), (301, 1)]:
+            bucket = jnp.asarray(rng.integers(0, nb, n), jnp.int32)
+            valid = jnp.asarray(rng.random(n) < 0.7)
+            _assert_rank_pair(bucket, valid, nb)
+
+    def test_all_one_bucket(self):
+        n = 300
+        bucket = jnp.zeros(n, jnp.int32)
+        _assert_rank_pair(bucket, jnp.ones(n, bool), 7)
+        # Ranks must be exactly arrival order in the single bucket.
+        got = dev._fifo_ranks_counting(bucket, jnp.ones(n, bool), 7, 8)
+        np.testing.assert_array_equal(np.asarray(got), np.arange(n))
+
+    def test_all_invalid_and_mixed(self):
+        rng = np.random.default_rng(3)
+        n = 200
+        bucket = jnp.asarray(rng.integers(0, 9, n), jnp.int32)
+        _assert_rank_pair(bucket, jnp.zeros(n, bool), 9)
+        # Alternating validity: ~valid rows rank among themselves via
+        # the sentinel bucket, exactly like the argsort sentinel key.
+        valid = jnp.asarray(np.arange(n) % 2 == 0)
+        _assert_rank_pair(bucket, valid, 9)
+
+    def test_block_larger_than_rows(self):
+        bucket = jnp.asarray([0, 1, 0, 1, 0], jnp.int32)
+        _assert_rank_pair(bucket, jnp.ones(5, bool), 2,
+                          blocks=(8, 16, 32, 64))
+
+
+class TestRankModePolicy:
+    def test_wm_shift_zero_static_fallback(self):
+        # The small-store regime (capacity <= 2^9 => wm_shift == 0)
+        # keeps argsort for EVERY policy, counting included.
+        for policy in ("auto", "argsort", "counting"):
+            assert dev.rank_mode(policy, 4096, 512, 0) == ("argsort", 0)
+
+    def test_scratch_infeasible_degrades(self):
+        # Bench-ring scale: no block fits => argsort, even forced.
+        assert dev.rank_mode("counting", 2_000_000, 800_000,
+                             13) == ("argsort", 0)
+        assert dev.rank_block_for(2_000_000, 800_000) == 0
+
+    def test_counting_engages_when_feasible(self):
+        # Forced counting engages on any backend (what the CI gates
+        # pin the path with); "auto" is backend-aware — on this CPU
+        # suite it keeps argsort (the faster implementation here),
+        # on TPU it picks counting at the same shape.
+        kind, blk = dev.rank_mode("counting", 8192, 1600, 3)
+        assert kind == "counting" and blk in dev._RANK_BLOCKS
+        import jax
+
+        want = "counting" if jax.default_backend() == "tpu" else "argsort"
+        assert dev.rank_mode("auto", 8192, 1600, 3)[0] == want
+
+    def test_unknown_policy_raises(self):
+        with pytest.raises(ValueError):
+            dev.rank_mode("bogus", 10, 10, 3)
+
+    def test_small_store_wm_shift_is_zero(self):
+        # The archive-phase geometry (capacity 2^8) computes
+        # wm_shift == 0 in ingest_step, so its rank_mode is argsort
+        # for every policy — the same derivation ingest_step uses.
+        cap = 1 << 8
+        wm_shift = max(0, cap.bit_length() - 1 - dev._WM_COARSE_FRAC_BITS)
+        assert wm_shift == 0
+        assert dev.rank_mode("counting", 2048, 512,
+                             wm_shift) == ("argsort", 0)
+
+    @pytest.mark.slow
+    def test_small_store_config_uses_argsort(self):
+        # A capacity-2^8 store (the archive-phase geometry) computes
+        # wm_shift == 0 in ingest_step — drive one batch and check the
+        # recorded active path.
+        cfg = dev.StoreConfig(
+            capacity=1 << 8, ann_capacity=1 << 9, bann_capacity=1 << 8,
+            max_services=8, max_span_names=16,
+            max_annotation_values=32, max_binary_keys=16,
+            cms_width=1 << 8, hll_p=6, quantile_buckets=64,
+            rank_path="counting",
+        )
+        store = TpuSpanStore(cfg)
+        traces = generate_traces(n_traces=8, max_depth=2, n_services=4)
+        store.apply([s for t in traces for s in t][:48])
+        paths = dev.active_paths(cfg)
+        assert paths["rank"] == ("argsort",)
+        assert store.counters()["rank_path_counting"] == 0.0
+
+
+def _drive_pair(spans, chunk=64):
+    """Same spans through an argsort store and a counting store (one
+    shared config-pair geometry => the jit cache is paid once for the
+    whole module)."""
+    stores = []
+    for cfg in (CFG_ARG, CFG_CNT):
+        st = TpuSpanStore(cfg)
+        for i in range(0, len(spans), chunk):
+            st.apply(spans[i:i + chunk])
+        stores.append(st)
+    return stores
+
+
+class TestArenaStateEquivalence:
+    def test_duplicate_heavy_workload(self):
+        # One service, one span name: every candidate row of a batch
+        # piles into a handful of buckets (heavy in-batch overflow,
+        # the displacement machinery's worst case).
+        traces = generate_traces(n_traces=45, max_depth=3,
+                                 n_services=1)
+        spans = [s for t in traces for s in t][:280]
+        a, c = _drive_pair(spans)
+        assert states_bitwise_equal(a.state, c.state)
+        assert dev.active_paths(CFG_CNT)["rank"] == ("counting",)
+        assert dev.active_paths(CFG_ARG)["rank"] == ("argsort",)
+
+    @pytest.mark.slow
+    def test_all_one_trace_bucket(self):
+        # A single trace: every trace-membership row of every batch
+        # lands in ONE bucket (the all-one-bucket regime), wrapping
+        # its FIFO several times over. (The rank-VECTOR all-one-bucket
+        # case stays in tier-1 above; this is the whole-store twin.)
+        traces = generate_traces(n_traces=1, max_depth=6,
+                                 n_services=4)
+        spans = [s for t in traces for s in t]
+        spans = (spans * (200 // max(1, len(spans)) + 1))[:200]
+        a, c = _drive_pair(spans)
+        assert states_bitwise_equal(a.state, c.state)
+
+    @pytest.mark.slow
+    def test_sparse_empty_buckets(self):
+        # Many services/names over few spans: most buckets stay empty
+        # and writes never wrap (the trivially-complete regime).
+        traces = generate_traces(n_traces=20, max_depth=2,
+                                 n_services=16)
+        spans = [s for t in traces for s in t][:100]
+        a, c = _drive_pair(spans)
+        assert states_bitwise_equal(a.state, c.state)
+
+
+@pytest.mark.slow
+class TestEscalatedBatchSweep:
+    def test_large_batch_geometries_bitwise(self):
+        # The batch-escalation sweep: the SAME spans at several
+        # batch_spans geometries, argsort vs counting at each — launch
+        # shapes change (bigger pads), bitwise identity must not.
+        traces = generate_traces(n_traces=700, max_depth=3,
+                                 n_services=8)
+        spans = [s for t in traces for s in t][:4000]
+        for bs in (128, 256, 512):
+            pair = []
+            for rank_path in ("argsort", "counting"):
+                cfg = dev.StoreConfig(**BASE, rank_path=rank_path,
+                                      batch_spans=bs)
+                st = TpuSpanStore(cfg)
+                for i in range(0, len(spans), 1024):
+                    st.apply(spans[i:i + 1024])
+                pair.append(st)
+            a, c = pair
+            assert states_bitwise_equal(a.state, c.state), bs
+            assert a.counters()["batch_spans_limit"] == float(bs)

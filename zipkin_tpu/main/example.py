@@ -33,6 +33,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "to simulate")
     p.add_argument("--capacity", type=int, default=1 << 16,
                    help="span ring capacity (device store)")
+    p.add_argument("--batch-spans", type=int, default=0,
+                   help="ingest batch escalation: max spans per device "
+                        "launch (0 = the store's legacy 4096 default; "
+                        "the ring guards still clamp to capacity/2 — "
+                        "see docs/PERFORMANCE.md for picking the knee)")
+    p.add_argument("--use-pallas", action="store_true",
+                   help="route ingest scatter-adds (and, when the "
+                        "index arena fits VMEM, the fused claim+"
+                        "scatter) through the pallas kernels instead "
+                        "of XLA scatter; the active path is reported "
+                        "in counters()/metrics (scatter_path_pallas)")
+    p.add_argument("--rank-path", default="auto",
+                   choices=("auto", "argsort", "counting"),
+                   help="index-write FIFO rank implementation (both "
+                        "are bitwise-identical; auto picks the "
+                        "counting sort when its scratch fits — "
+                        "docs/PERFORMANCE.md)")
     p.add_argument("--sample-rate", type=float, default=1.0)
     p.add_argument("--adaptive-target", type=float, default=0.0,
                    help="target stored spans/minute; 0 disables adaptive")
@@ -147,13 +164,23 @@ def build_app(args):
             mesh = Mesh(np.array(devices[:args.shards]),
                         axis_names=("shard",))
             store = ShardedSpanStore(
-                mesh, StoreConfig(capacity=args.capacity)
+                mesh, StoreConfig(
+                    capacity=args.capacity,
+                    batch_spans=args.batch_spans,
+                    use_pallas=args.use_pallas,
+                    rank_path=args.rank_path,
+                )
             )
         else:
             from zipkin_tpu.store.device import StoreConfig
             from zipkin_tpu.store.tpu import TpuSpanStore
 
-            store = TpuSpanStore(StoreConfig(capacity=args.capacity))
+            store = TpuSpanStore(StoreConfig(
+                capacity=args.capacity,
+                batch_spans=args.batch_spans,
+                use_pallas=args.use_pallas,
+                rank_path=args.rank_path,
+            ))
     if args.cold_tier:
         if hasattr(store, "archive"):
             # Restored tiered checkpoint: already wrapped, but the

@@ -14,24 +14,33 @@ CMS 4×65536 i32 = 1MB both do. On CPU the kernels run in interpreter
 mode (tests); on TPU they compile natively. ``flat_histogram`` is the
 generic primitive; ``cms_update`` reuses it per sketch row.
 
-Why the INDEX-FAMILY scatter block is NOT a Pallas kernel (the r6
-decision, NOTES_r06.md §3 carries the arithmetic): the VMEM-residency
-trick above is what makes these kernels win, and it fundamentally does
-not transfer. The unified index arena at the bench geometry is
-~0.5-1.6 GB ([slots, 3] i64 entries) — 30-100x VMEM — and the
-destination slots are hash-scattered across ALL of it, so a Pallas
-version must stream HBM tiles exactly like XLA's scatter does, with no
-reuse to amortize: each of the ~1.4M batch rows touches 24 bytes of a
-~1 GB array once. The measured fast path (unique-index i32 plane
-scatters at ~4.5 ns/row, scripts/profile_scatter*.py) already runs
-within ~2x of the pure HBM write-bandwidth bound for that access
-pattern; the remaining gap is random-access DMA latency, which a
-hand-rolled kernel pays identically. The wins that WERE available —
-fewer passes over the rows (one rank sort, one displaced-row gather,
-one shared watermark scatter for all seven families) — are
-access-PATTERN restructurings, landed in store/device.py where XLA
-fuses them fine. A Pallas arena kernel would re-derive the same DMA
-schedule at much higher maintenance cost.
+Why the INDEX-FAMILY scatter block was NOT a Pallas kernel at bench
+geometry (the r6 decision, NOTES_r06.md §3 carries the arithmetic):
+the VMEM-residency trick above is what makes these kernels win, and it
+does not transfer to arenas that dwarf VMEM. The unified index arena
+at the bench geometry is ~0.5-1.6 GB ([slots, 3] i64 entries) —
+30-100x VMEM — and the destination slots are hash-scattered across ALL
+of it, so a Pallas version must stream HBM tiles exactly like XLA's
+scatter does, with no reuse to amortize: each of the ~1.4M batch rows
+touches 24 bytes of a ~1 GB array once. The measured fast path
+(unique-index i32 plane scatters at ~4.5 ns/row,
+scripts/profile_scatter*.py) already runs within ~2x of the pure HBM
+write-bandwidth bound for that access pattern; the remaining gap is
+random-access DMA latency, which a hand-rolled kernel pays
+identically.
+
+r12 re-opens the SMALL-arena half of that question with
+``arena_claim_scatter``: when the whole [slots, 3] arena (as six i32
+bit-planes) plus the per-bucket cursor walk DOES fit VMEM, a
+grid-sequential kernel fuses the FIFO slot claim (a running cursor
+histogram — the work the XLA path buys with a rank sort) and the
+six-plane entry scatter into one pass with zero atomics (TPU grids run
+sequentially, pallas_guide.md). ``arena_scatter_supported`` is the
+VMEM-fit oracle; bigger arenas keep the XLA plane-scatter path and the
+r6 roofline conclusion stands for them unchanged. Gated behind
+``StoreConfig.use_pallas`` (default OFF) until the profile arms
+(scripts/profile_ingest.py --arena-arm, bench.py --ingest-matrix)
+prove it on-chip.
 """
 
 from __future__ import annotations
@@ -154,6 +163,147 @@ def cms_update(counts, idx_rows, weights=None, tile: int = DEFAULT_TILE):
         wts = jnp.broadcast_to(weights, (d, n)).reshape(-1).astype(counts.dtype)
     delta = flat_histogram(flat_idx, wts, d * w, tile)
     return counts + delta.reshape(d, w)
+
+
+# ---------------------------------------------------------------------------
+# Fused index-arena claim + entry scatter (r12)
+# ---------------------------------------------------------------------------
+
+# VMEM budget for the arena kernel's resident state: 6 input + 6 output
+# entry planes + the cursor histogram, all i32. ~10 MB leaves headroom
+# for the SMEM row tiles and compiler temporaries inside the ~16 MB
+# core budget.
+ARENA_VMEM_BUDGET = 10 << 20
+ARENA_TILE = 512
+
+
+def arena_scatter_supported(total_slots: int, n_buckets: int) -> bool:
+    """True when the unified arena fits the kernel's VMEM-resident
+    model (the r6 roofline boundary: past this, any kernel degenerates
+    to the same random-access HBM DMA XLA already issues). Also guards
+    the kernel's i32 slot arithmetic."""
+    if total_slots <= 0 or total_slots >= (1 << 31):
+        return False
+    if n_buckets <= 0 or n_buckets >= (1 << 31):
+        return False
+    sp = -(-total_slots // LANES) * LANES
+    bp = -(-n_buckets // LANES) * LANES
+    return (12 * sp + bp) * 4 <= ARENA_VMEM_BUDGET
+
+
+def _arena_kernel(bucket_ref, base_ref, slot0_ref, dmask_ref, valid_ref,
+                  v0, v1, v2, v3, v4, v5,
+                  e0, e1, e2, e3, e4, e5,
+                  o0, o1, o2, o3, o4, o5,
+                  cur_ref):
+    # Same Mosaic discipline as _hist_kernel: per-row scalars from
+    # rank-1 SMEM blocks, VMEM state updated by row-granular RMWs with
+    # one-hot lane selects (dynamic SUBLANE indexing is legal, dynamic
+    # LANE indexing is not), i32 everywhere (no 64-bit lowering on TPU
+    # pallas — the arena travels as bit-planes).
+    i = pl.program_id(0)
+    tile = bucket_ref.shape[0]
+    vins = (v0, v1, v2, v3, v4, v5)
+    eins = (e0, e1, e2, e3, e4, e5)
+    outs = (o0, o1, o2, o3, o4, o5)
+
+    @pl.when(i == 0)
+    def _():
+        # The cursor walk starts from zero: ``base`` already carries
+        # each row's bucket cursor (pos low word), so the kernel only
+        # counts THIS launch's same-bucket predecessors — exactly the
+        # FIFO rank the argsort/counting paths compute.
+        cur_ref[:, :] = jnp.zeros_like(cur_ref)
+        for e, o in zip(eins, outs):
+            o[:, :] = e[:, :]
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+
+    def body(t, carry):
+        @pl.when(valid_ref[t] != 0)
+        def _():
+            b = bucket_ref[t]
+            onehot_b = (lane == (b & 127)).astype(jnp.int32)
+            crow = cur_ref[pl.ds(b >> 7, 1), :]
+            c = jnp.sum(crow * onehot_b)
+            cur_ref[pl.ds(b >> 7, 1), :] = crow + onehot_b
+            # The claim: this row's FIFO slot, from the bucket's live
+            # cursor. Writes land in arrival order, so an in-batch
+            # overflow row is overwritten by its newest same-slot
+            # successor — the final arena equals the rank-gated unique
+            # scatter's bitwise (store/device._index_write).
+            slot = slot0_ref[t] + ((base_ref[t] + c) & dmask_ref[t])
+            hit = lane == (slot & 127)
+            for v, o in zip(vins, outs):
+                row = o[pl.ds(slot >> 7, 1), :]
+                o[pl.ds(slot >> 7, 1), :] = jnp.where(hit, v[t], row)
+
+        return carry
+
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(tile), body, None)
+
+
+@functools.partial(jax.jit, static_argnames=("n_buckets", "tile"))
+def arena_claim_scatter(entries, bucket, base, slot0, depth, vals,
+                        valid, n_buckets: int, tile: int = ARENA_TILE):
+    """Fused FIFO claim + entry-row scatter over the unified [slots, 3]
+    i64 index arena. Per valid row: claim the bucket's next FIFO slot
+    (``slot0 + ((base + cursor++) & (depth - 1))``) and store the row's
+    three i64 columns as six i32 planes. Grid steps run sequentially on
+    a TPU core, so the cursor walk needs no atomics and write order is
+    arrival order — the final arena is bitwise-identical to the XLA
+    path's rank-gated unique scatter (fuzz-gated by
+    tests/test_pallas_kernels.py).
+
+    ``bucket`` must be clipped to [0, n_buckets); ``base`` is each
+    row's bucket cursor low word (pos_lo[bucket], already gathered by
+    the caller); ``depth`` per-row powers of two; callers check
+    ``arena_scatter_supported`` first (whole-arena VMEM residency).
+    """
+    S = entries.shape[0]
+    n = bucket.shape[0]
+    if n == 0:
+        return entries
+    sp = -(-S // LANES) * LANES
+    bp = -(-n_buckets // LANES) * LANES
+    n_tiles = -(-n // tile)
+    pad = n_tiles * tile - n
+    # Arena -> six plane-major i32 buffers ([S] each, lane-padded): a
+    # row's (gid, verify, ts) i64 columns become planes 2c (lo) and
+    # 2c+1 (hi) — the same bitcast _p32 uses, kept plane-major so each
+    # kernel write is one contiguous VMEM row RMW.
+    p = jax.lax.bitcast_convert_type(entries, jnp.int32).reshape(S, 6)
+    planes = jnp.pad(jnp.moveaxis(p, 0, 1), ((0, 0), (0, sp - S)))
+    planes = planes.reshape(6, sp // LANES, LANES)
+    v = jax.lax.bitcast_convert_type(
+        jnp.asarray(vals, jnp.int64), jnp.int32).reshape(n, 6)
+
+    def padi(x, dtype=jnp.int32):
+        return jnp.pad(jnp.asarray(x, dtype), (0, pad))
+
+    row_ins = [
+        padi(bucket), padi(base), padi(slot0), padi(
+            jnp.asarray(depth, jnp.int32) - 1),
+        padi(jnp.asarray(valid).astype(jnp.int32)),
+    ] + [padi(v[:, j]) for j in range(6)]
+    smem = pl.BlockSpec((tile,), lambda i: (i,),
+                        memory_space=pltpu.SMEM)
+    vblock = pl.BlockSpec((sp // LANES, LANES),
+                          lambda i: (i - i, i - i))
+    outs = pl.pallas_call(
+        _arena_kernel,
+        grid=(n_tiles,),
+        in_specs=[smem] * 11 + [vblock] * 6,
+        out_specs=[vblock] * 6,
+        out_shape=[
+            jax.ShapeDtypeStruct((sp // LANES, LANES), jnp.int32)
+        ] * 6,
+        scratch_shapes=[pltpu.VMEM((bp // LANES, LANES), jnp.int32)],
+        interpret=_interpret(),
+    )(*row_ins, *(planes[j] for j in range(6)))
+    flat = jnp.stack(outs).reshape(6, sp)[:, :S]
+    return jax.lax.bitcast_convert_type(
+        jnp.moveaxis(flat, 0, 1).reshape(S, 3, 2), jnp.int64)
 
 
 def scatter_histogram_xla(counts, idx, weights=None):

@@ -1,0 +1,42 @@
+"""The ONE home of the fused-ingest StableHLO census ceilings.
+
+Per-kernel overhead dominates the target device class (NOTES_r03 §3),
+so the scatter/gather/sort counts of the compiled ingest step are the
+portable proxy for its TPU cost — the r6 unified index arena exists to
+hold them down, and the tier-1 lane gates them every CI run. These
+ceilings used to live as three hard-coded copies (bench_smoke docs,
+the tier-1 test, the notes); a path change now updates exactly one
+number here, consumed by ``scripts/bench_smoke.py`` and
+``tests/test_bench_smoke.py``.
+
+History of the measured counts at the smoke shapes:
+
+- r5 split index design: 101 scatters / 6 sorts / 80 gathers;
+- r6 unified arena:       95 / 5 / 79;
+- r12 counting-sort rank:  95 / 4 / 79 — the ``_fifo_ranks`` argsort
+  is replaced by a segmented counting rank (one duplicate-index
+  scatter-add + cumsum + one gather, spending exactly the scatter and
+  gather the argsort path's unsort freed), deleting the last hot-path
+  ``stablehlo.sort`` the index write owned. The argsort path remains
+  selectable (``StoreConfig.rank_path``) and bitwise-identical; its
+  lowering sits at ARGSORT_STEP_SORTS.
+
+Raise a ceiling only with a NOTES entry explaining what bought the
+extra launches.
+"""
+
+# Fused-step ceilings (the tier-1 gate, tests/test_bench_smoke.py).
+MAX_STEP_SCATTERS = 95
+MAX_STEP_SORTS = 4
+MAX_STEP_GATHERS = 79
+
+# The argsort rank path's sort count — the pre-r12 ceiling, still the
+# expected lowering when rank_path="argsort" (or the wm_shift == 0 /
+# scratch-infeasible fallbacks) is active.
+ARGSORT_STEP_SORTS = 5
+
+# Stage-1 sketch-mirror budget: the host COO delta (store/mirror,
+# riding the hot encode path since r11) may add at most this fraction
+# to the encode stage — bench_smoke's ingest-structure phase measures
+# it paired and the tier-1 test gates it.
+MAX_MIRROR_DELTA_RATIO = 0.05

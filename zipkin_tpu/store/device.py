@@ -29,6 +29,7 @@ retraces only when shapes actually change.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Dict, NamedTuple, Tuple
@@ -108,7 +109,30 @@ class StoreConfig(NamedTuple):
     # histogram kernels (ops/pallas_kernels.py) instead of XLA scatter.
     # Benchmarked on the real chip by bench.py --compare-kernels; arrays
     # whose size is not a multiple of 128 lanes fall back to XLA.
+    # With r12 this also routes the index-arena entry scatter through
+    # the grid-sequential claim+scatter kernel WHEN the arena fits VMEM
+    # (pallas_kernels.arena_scatter_supported); bigger arenas keep the
+    # XLA plane-scatter path (the NOTES_r06 §3 roofline boundary).
     use_pallas: bool = False
+    # Host-side per-launch span bound (the ingest batch-escalation knob,
+    # r12): 0 keeps the store's legacy MAX_CHUNK default (4096); larger
+    # values let one launch carry more spans, amortizing the per-launch
+    # scatter entry costs — re-measure the knee with
+    # scripts/profile_ingest.py --batch-spans-sweep / bench.py
+    # --ingest-matrix. The ring-capacity guards (capacity//2,
+    # pending_slots, ann/bann rings) still clamp it per launch.
+    batch_spans: int = 0
+    # FIFO-rank computation for the unified index write (_index_write):
+    # "argsort" = the r6 stable rank sort; "counting" = the r12
+    # segmented counting rank (one scatter-add + cumsum + one gather —
+    # no stablehlo.sort); "auto" picks counting on the TPU backend
+    # whenever the coarse watermark regime is active (wm_shift > 0)
+    # and the counting scratch fits its budget, argsort otherwise
+    # (incl. everywhere on CPU, where the comparator sort is the
+    # faster implementation — see rank_mode). Both paths are
+    # BITWISE-identical (tests/test_rank_paths.py fuzzes this), so the
+    # choice is pure perf policy and may vary per launch shape.
+    rank_path: str = "auto"
 
     @property
     def tab_slots(self) -> int:
@@ -1239,11 +1263,165 @@ def _fifo_ranks(bucket, valid, n_buckets: int):
     return rank
 
 
+# -- segmented counting-sort ranks (r12) -------------------------------------
+#
+# The r12 alternative to _fifo_ranks' stable argsort: the within-bucket
+# arrival rank decomposes as (same-bucket rows in EARLIER row blocks) +
+# (same-bucket earlier rows in MY block). The first term is a counting
+# sort — the per-(bucket, block) occupancy histogram is ONE i32
+# duplicate-index scatter-add (the same vectorized class as the bucket
+# count the write pass already pays) turned into prefixes by a cumsum
+# along the block axis, read back by ONE gather; the second term is
+# block-1 shifted elementwise equality tests. Net census vs the argsort
+# path: -1 stablehlo.sort, ±0 scatters, ±0 gathers (the argsort path
+# spends 1 scatter + 1 gather on its unsort), and the O(N log N)
+# comparator sort disappears from the compile.
+#
+# The scratch is the dense [(n_buckets+1) x ceil(N/block)] histogram —
+# it scales with buckets x rows, so huge-arena geometries (the 2^22
+# bench rings, whose trace families alone carry ~800k buckets) blow any
+# block size past the budget and statically keep the argsort path;
+# rank_block_for is the feasibility oracle and docs/PERFORMANCE.md
+# carries the arithmetic. Both paths are BITWISE-identical for every
+# row (including the ~valid sentinel-bucket rows), fuzz-gated by
+# tests/test_rank_paths.py.
+
+# Block sizes tried smallest-first (each must be a power of two: block
+# membership tests mask with block-1). Bigger blocks shrink the scratch
+# but pay (block-1) shifted compares; past 64 the elementwise tail
+# would dominate the sort it replaces.
+_RANK_BLOCKS = (8, 16, 32, 64)
+# Scratch budget in i32 elements (128 MiB transient): generous for
+# smoke/test geometries and wide enough that MID-size bench rings
+# (cap 2^16 at ~57k-row launches, block 64) still engage counting so
+# the on-chip matrix arms can measure the sort-vs-counting delta; the
+# 2^22 cert geometry (~800k buckets x ~2M rows) is out of reach for
+# ANY block size — docs/PERFORMANCE.md carries the arithmetic — and
+# statically keeps argsort.
+_RANK_SCRATCH_ELEMS = 1 << 25
+
+
+def rank_block_for(n_rows: int, n_buckets: int) -> int:
+    """Smallest feasible counting-rank block size for a launch shape
+    (0 = no block fits the scratch budget; take the argsort path)."""
+    for blk in _RANK_BLOCKS:
+        groups = -(-n_rows // blk)
+        if (n_buckets + 1) * groups <= _RANK_SCRATCH_ELEMS:
+            return blk
+    return 0
+
+
+def rank_mode(rank_path: str, n_rows: int, n_buckets: int,
+              wm_shift: int):
+    """Static rank-path decision for one launch shape: ("argsort", 0)
+    or ("counting", block). The wm_shift == 0 small-store regime stays
+    on argsort even when counting is requested — tiny rings mean tiny
+    batches, where the counting pass's fixed overhead (scratch zeroing
+    + shifted compares) buys nothing, and keeping one static policy per
+    regime keeps the compile-cache story simple (mirrors the exact
+    gid-war fallback in _index_write).
+
+    "auto" is BACKEND-aware: the counting sort exists to delete a TPU
+    sort bottleneck; on the CPU backend XLA's sort is fast and the
+    counting scratch traffic measurably LOSES (~+11% on device-heavy
+    tier-1 modules, r12 measurement), so auto picks counting only on
+    TPU. An explicit "counting" is honored on every backend — that is
+    what the CI equivalence/census gates pin the path with. The choice
+    is always bitwise-neutral, so a checkpoint moving between backends
+    never diverges."""
+    if rank_path not in ("auto", "argsort", "counting"):
+        raise ValueError(f"unknown rank_path {rank_path!r}")
+    if rank_path == "argsort" or wm_shift == 0:
+        return "argsort", 0
+    if rank_path == "auto" and jax.default_backend() != "tpu":
+        return "argsort", 0
+    blk = rank_block_for(n_rows, n_buckets)
+    if blk == 0:
+        # Scratch infeasible at this geometry: "counting" degrades to
+        # argsort rather than OOMing the device (recorded in the
+        # active-paths registry so counters()/bench say what ran).
+        return "argsort", 0
+    return "counting", blk
+
+
+def _fifo_ranks_counting(bucket, valid, n_buckets: int, block: int):
+    """Counting-sort twin of _fifo_ranks: bitwise-identical rank vector
+    (valid rows rank among same-bucket valid rows, ~valid rows among
+    themselves via the sentinel bucket — exactly the argsort path's
+    sentinel-key semantics), built from one duplicate-index i32
+    scatter-add, one cumsum, one gather, and block-1 shifted compares.
+    ``block`` must be a power of two (see _RANK_BLOCKS); valid rows
+    must carry bucket in [0, n_buckets) — the same contract the argsort
+    path's callers already honor (_index_write's seg() clips)."""
+    n = bucket.shape[0]
+    groups = -(-n // block)
+    b_eff = jnp.where(
+        valid, jnp.clip(bucket, 0, n_buckets - 1).astype(jnp.int32),
+        jnp.int32(n_buckets),
+    )
+    rows = jnp.arange(n, dtype=jnp.int32)
+    g = rows // jnp.int32(block)
+    sidx = b_eff * jnp.int32(groups) + g
+    # Per-(bucket, block) occupancy — duplicate-index i32 scatter-add,
+    # the vectorized class (profile_scatter*.py); indices are in-range
+    # by construction, mode="drop" is belt-and-braces.
+    cnt = jnp.zeros((n_buckets + 1) * groups, jnp.int32).at[sidx].add(
+        1, mode="drop")
+    cnt2 = cnt.reshape(n_buckets + 1, groups)
+    # Exclusive prefix along the block axis: same-bucket rows in
+    # earlier blocks.
+    prefix = (jnp.cumsum(cnt2, axis=1) - cnt2).reshape(-1)
+    pre = prefix[sidx]
+    # Same-bucket earlier rows within my block: block-1 shifted
+    # equality tests, masked to block membership (blocks are aligned —
+    # row i and i-d share a block iff i % block >= d).
+    in_block = rows & jnp.int32(block - 1)
+    w = jnp.zeros(n, jnp.int32)
+    for d in range(1, min(block, n)):
+        same = jnp.concatenate(
+            [jnp.zeros(d, bool), b_eff[d:] == b_eff[:-d]])
+        w = w + (same & (in_block >= d)).astype(jnp.int32)
+    return pre + w
+
+
+# Active-path registry: which rank / arena-scatter implementations each
+# StoreConfig's compiled steps actually took (trace-time records — one
+# entry per compile, so steady state writes nothing). Surfaced through
+# TpuSpanStore.counters() -> /metrics and the bench JSON, so every
+# recorded spans/s figure says which kernels produced it. The lock
+# guards reads against a concurrent first-compile on another thread (a
+# /metrics scrape during a pipelined store's new-shape trace must not
+# see a set mid-mutation). Entries live as long as the process, keyed
+# by config — the SAME lifecycle and sharing as the jit caches whose
+# path choices they record: a new store reusing a config also reuses
+# those compiled steps, so the inherited record is accurate for it.
+_ACTIVE_PATHS: Dict[StoreConfig, Dict[str, set]] = {}
+_ACTIVE_PATHS_LOCK = threading.Lock()
+
+
+def _note_path(config: StoreConfig, kind: str, value: str) -> None:
+    with _ACTIVE_PATHS_LOCK:
+        _ACTIVE_PATHS.setdefault(config, {}).setdefault(
+            kind, set()).add(value)
+
+
+def active_paths(config: StoreConfig) -> Dict[str, Tuple[str, ...]]:
+    """{"rank": ("counting", ...), "scatter": ("xla", ...)} — every
+    implementation this config's compiled ingest steps used (may hold
+    both when different launch shapes picked different modes)."""
+    with _ACTIVE_PATHS_LOCK:
+        return {
+            k: tuple(sorted(v))
+            for k, v in _ACTIVE_PATHS.get(config, {}).items()
+        }
+
+
 def _index_write(entries, pos, wm, key_tab, key_wm, ann_poison,
                  gbucket, slot0, depth, gid, verify, ts, valid,
                  keyed_from: int, n_cand_rows: int, n_cand_buckets: int,
                  poison_bucket=None, poison_gid=None, poison_ok=None,
-                 wm_shift: int = 0, ts_shift: int = _WM_TS_SHIFT):
+                 wm_shift: int = 0, ts_shift: int = _WM_TS_SHIFT,
+                 rank_sel=("argsort", 0), scatter_mode: str = "xla"):
     """ONE combined append of (gid, verify, ts) rows into the UNIFIED
     index arena — candidate families and trace-membership families
     alike: ``gbucket`` is the global bucket id (addressing pos/wm),
@@ -1285,7 +1463,11 @@ def _index_write(entries, pos, wm, key_tab, key_wm, ann_poison,
     store's lifetime, an ABSENT record proves its key was never indexed
     — the negative-lookup gate (see iquery wrappers)."""
     n_b = pos.shape[0]
-    rank = _fifo_ranks(gbucket, valid, n_b)
+    rank_kind, rank_blk = rank_sel
+    if rank_kind == "counting":
+        rank = _fifo_ranks_counting(gbucket, valid, n_b, rank_blk)
+    else:
+        rank = _fifo_ranks(gbucket, valid, n_b)
     b_c = jnp.clip(gbucket, 0, n_b - 1)
     oob_b = jnp.where(valid, b_c, n_b)
     cnt = jnp.zeros(n_b + 1, jnp.int32).at[oob_b].add(
@@ -1336,7 +1518,23 @@ def _index_write(entries, pos, wm, key_tab, key_wm, ann_poison,
     tr_ok = occupied[trc] | (valid[trc] & ~keep[trc])
     verify = jnp.asarray(verify, jnp.int64)
     vals = jnp.stack([gid, verify, jnp.asarray(ts, jnp.int64)], axis=-1)
-    entries = _uset_cols64(entries, slot, vals, keep)
+    if scatter_mode == "pallas":
+        # Grid-sequential fused claim+scatter (ops/pallas_kernels):
+        # the kernel re-derives each row's FIFO slot from a
+        # VMEM-resident cursor walk (claim) and writes ALL valid rows
+        # in arrival order — in-batch overflow rows are overwritten by
+        # their newest same-slot successor, which lands the bitwise
+        # SAME final arena as the rank-gated unique scatter (every
+        # dropped row's slot is rewritten by the rank+depth successor
+        # that displaced it). `keep`/`rank` stay load-bearing for the
+        # displacement bookkeeping above/below either way.
+        from zipkin_tpu.ops import pallas_kernels as PK
+
+        entries = PK.arena_claim_scatter(
+            entries, b_c, pos_b, slot0, depth, vals, valid,
+            n_buckets=n_b)
+    else:
+        entries = _uset_cols64(entries, slot, vals, keep)
     pos = pos + cnt.astype(pos.dtype)
 
     # -- per-key fingerprint records (suffix rows only) ----------------
@@ -2131,6 +2329,23 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         ))
         cat = [jnp.concatenate(parts)
                for parts in zip(*(p for _, p in segments))]
+        # Static per-shape path decisions (r12), recorded at trace time
+        # so counters()/bench can report which kernels a config's
+        # compiled steps actually used. Both rank paths are bitwise-
+        # identical, so a mixed-shape store (different pad buckets
+        # picking different modes) still lands one deterministic state.
+        from zipkin_tpu.ops import pallas_kernels as PK
+
+        rank_sel = rank_mode(
+            c.rank_path, cat[0].shape[0], c.idx_layout[1], wm_shift)
+        scatter_mode = (
+            "pallas"
+            if c.use_pallas and PK.arena_scatter_supported(
+                c.idx_layout[2], c.idx_layout[1])
+            else "xla"
+        )
+        _note_path(c, "rank", rank_sel[0])
+        _note_path(c, "scatter", scatter_mode)
         (upd["cand_idx"], upd["cand_pos"], upd["cand_wm"],
          upd["key_tab"], upd["key_wm"], upd["ann_poison"],
          n_key_drops) = _index_write(
@@ -2142,6 +2357,7 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
             poison_bucket=a_host, poison_gid=span_gid_of_ann,
             poison_ok=mid,
             wm_shift=wm_shift,
+            rank_sel=rank_sel, scatter_mode=scatter_mode,
         )
 
     # -- per-service latency histogram ---------------------------------

@@ -167,14 +167,22 @@ class IngestPipeline(_StageBase):
     call ``feed`` (stage 1's tail), readers are untouched — they
     snapshot ``store.state`` under the read lock exactly as before."""
 
-    def __init__(self, store, depth: int = 8, registry=None):
+    def __init__(self, store, depth: int = 8, registry=None,
+                 stage_buffers: int = 2):
         from zipkin_tpu import obs
 
         super().__init__()
         self._store = store
         self.depth = max(1, int(depth))
         self._prefetch: "queue.Queue" = queue.Queue(maxsize=self.depth)
-        self._staged: "queue.Queue" = queue.Queue(maxsize=2)
+        # Staged (device-resident) units in flight: 2 = classic double
+        # buffering (one committing, one staging). Batch-escalated
+        # deployments (StoreConfig.batch_spans, r12) may raise it so a
+        # long device step never starves the H2D stage, at the cost of
+        # stage_buffers x batch_spans of staged device memory.
+        self.stage_buffers = max(1, int(stage_buffers))
+        self._staged: "queue.Queue" = queue.Queue(
+            maxsize=self.stage_buffers)
         reg = registry or obs.default_registry()
         self._registry = reg
         self.h_encode = reg.register(obs.LatencySketch(

@@ -456,6 +456,8 @@ class TpuSpanStore(SpanStore):
     INGEST_SYNC_EVERY = 32
     # Default prefetch depth for start_pipeline(None).
     PIPELINE_DEPTH = 8
+    # Default staged-unit (H2D double-buffer) slots for start_pipeline.
+    STAGE_BUFFERS = 2
     # Default async-seal backlog: 0 = seal inline on the write path
     # (bitwise-deterministic timing, the library default); deployments
     # that want capture off the critical path set capture_backlog > 0
@@ -569,9 +571,17 @@ class TpuSpanStore(SpanStore):
     def _max_chunk_spans(self) -> int:
         """One-launch span bound: the span ring (colliding-slot scatter
         guard) AND the pending ring (a launch's unresolved children must
-        fit without self-collision) both cap it."""
+        fit without self-collision) both cap it. ``config.batch_spans``
+        (the r12 batch-escalation knob) replaces the legacy MAX_CHUNK
+        ceiling when set — bigger launches amortize the per-launch
+        index-write entry costs; the ring guards still clamp."""
         c = self.config
-        return min(self.MAX_CHUNK, c.capacity // 2 or 1, c.pending_slots)
+        # <= 0 means "default" (and a negative knob value must never
+        # reach the chunkers: a non-positive chunk size turns
+        # _chunk_by_trace's split loop into an infinite empty-yield).
+        limit = c.batch_spans if c.batch_spans > 0 else self.MAX_CHUNK
+        return max(1, min(limit, c.capacity // 2 or 1,
+                          c.pending_slots))
 
     def _prune_ttls(self) -> None:
         prune_ttls(self.ttls, self.MAX_TTL_ENTRIES)
@@ -1180,22 +1190,26 @@ class TpuSpanStore(SpanStore):
 
     # -- pipelined ingest lifecycle (store/pipeline) --------------------
 
-    def start_pipeline(self, depth: Optional[int] = None
+    def start_pipeline(self, depth: Optional[int] = None,
+                       stage_buffers: Optional[int] = None
                        ) -> IngestPipeline:
         """Switch the write path to the three-stage ingest pipeline:
         apply/write_thrift become stage 1 (encode + pow2 pad, outside
         the device critical section), a stage thread device_puts into
         double-buffered staging slots, and a commit thread holds the
         write lock only for the donating swap. ``depth`` bounds the
-        prefetch queue (the writer backpressure). Reads are untouched;
-        they see a consistent, possibly a-few-batches-stale state
-        until drain_pipeline(). See docs/INGEST_PIPELINE.md."""
+        prefetch queue (the writer backpressure); ``stage_buffers``
+        sizes the staged-unit queue (default STAGE_BUFFERS = 2, the
+        classic double buffer — see IngestPipeline). Reads are
+        untouched; they see a consistent, possibly a-few-batches-stale
+        state until drain_pipeline(). See docs/INGEST_PIPELINE.md."""
         with self._lock:
             if self._pipeline is not None:
                 raise RuntimeError("ingest pipeline already running")
             self._pipeline = IngestPipeline(
                 self, depth or self.PIPELINE_DEPTH,
-                registry=self._registry)
+                registry=self._registry,
+                stage_buffers=stage_buffers or self.STAGE_BUFFERS)
             return self._pipeline
 
     def drain_pipeline(self) -> None:
@@ -1858,6 +1872,16 @@ class TpuSpanStore(SpanStore):
         s = self._sealer
         if s is not None:
             out["capture_backlog"] = float(s.queued())
+        # Active ingest kernel paths (r12): which rank / arena-scatter
+        # implementations this config's compiled steps took, so every
+        # /metrics scrape and bench record says which kernel produced
+        # its numbers (dev.active_paths — trace-time records).
+        paths = dev.active_paths(self.config)
+        out["rank_path_counting"] = float(
+            "counting" in paths.get("rank", ()))
+        out["scatter_path_pallas"] = float(
+            "pallas" in paths.get("scatter", ()))
+        out["batch_spans_limit"] = float(self._max_chunk_spans())
         return out
 
     def stored_span_count(self) -> float:
