@@ -1153,6 +1153,140 @@ def _sketch_ms(sketch) -> dict:
     }
 
 
+def bench_windows(total_spans: int = 200_000):
+    """Windowed-analytics phase (r13 tentpole, aggregate/windows.py):
+    what the (service × time-bucket) Moments-sketch arena costs on the
+    fused ingest step and what it buys at read time. Measures (a) the
+    window-on vs window-off spans/s delta — the arena's 5 extra
+    scatters riding the step (store/census.py r13 bump); (b) serve
+    p50/p99 for windowed_quantiles / slo_burn / latency_heatmap, all
+    answered from the host mirror cells with ZERO device dispatches;
+    (c) mirror-vs-device bitwise identity of the four window arrays;
+    (d) exactness — windowed error/total counts equal an exact span
+    scan (cell sums are exact) and the quantile estimate's rank error
+    vs the true duration distribution stays inside SOLVER_RANK_TOL."""
+    import numpy as np
+
+    import jax
+
+    from zipkin_tpu.aggregate import windows as win
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+
+    cap = 1 << max(10, total_spans.bit_length() - 1)
+    n_services = 16
+    config = dev.StoreConfig(
+        capacity=cap, ann_capacity=4 * cap, bann_capacity=2 * cap,
+        max_services=64, max_span_names=256,
+        max_annotation_values=512, max_binary_keys=64,
+        cms_width=1 << 12, hll_p=10, quantile_buckets=512,
+        window_seconds=60, window_buckets=64,
+    )
+    _log(f"windows phase: ring 2^{cap.bit_length() - 1}, "
+         f"{total_spans} spans, arena {config.max_services}x"
+         f"{config.window_buckets}")
+    rng = np.random.default_rng(13)
+    eps = [Endpoint(1 + i, 80, f"wsvc{i:02d}") for i in range(n_services)]
+    base = 1_700_000_000_000_000
+    # Spread first-timestamps over half the ring's retention so dozens
+    # of time buckets are live; ~8% of spans carry the "error"
+    # annotation convention.
+    span_us = config.window_us * (config.window_buckets // 2)
+    offs = rng.integers(0, span_us, total_spans)
+    durs = (np.exp(rng.normal(7.0, 1.3, total_spans)).astype(np.int64)
+            + 1)
+    spans = []
+    for i in range(total_spans):
+        ep = eps[i % n_services]
+        t0 = base + int(offs[i])
+        anns = [Annotation(t0, "sr", ep),
+                Annotation(t0 + int(durs[i]), "ss", ep)]
+        if i % 12 == 0:
+            anns.append(Annotation(t0 + 1, "error", ep))
+        spans.append(Span(i // 4 + 1, f"op{i % 8}", i + 1, None,
+                          tuple(anns), ()))
+    chunk = 1024
+
+    def stream(store):
+        t0 = time.perf_counter()
+        for i in range(0, len(spans), chunk):
+            store.apply(spans[i:i + chunk])
+        return time.perf_counter() - t0
+
+    # (a) fused-step cost: warm both lowerings, then time each.
+    cfg_off = config._replace(window_seconds=0)
+    stream(TpuSpanStore(cfg_off))
+    warm_on = TpuSpanStore(config)
+    stream(warm_on)
+    off_s = stream(TpuSpanStore(cfg_off))
+    store = TpuSpanStore(config)
+    on_s = stream(store)
+
+    # (c) bitwise identity of the arena vs its mirror twins.
+    st = store.state
+    dev_arrays = jax.device_get(
+        (st.win_epoch, st.win_counts, st.win_sums, st.win_mm))
+    mir = store.sketch_mirror
+    bitwise = all(np.array_equal(a, b) for a, b in zip(
+        dev_arrays,
+        (mir.win_epoch, mir.win_counts, mir.win_sums, mir.win_mm)))
+
+    # (b) serve latency: all three endpoints off the mirror cells.
+    svc = "wsvc01"
+    qs = [0.5, 0.95, 0.99]
+    lat = {"windowed_quantiles": [], "slo_burn": [], "latency_heatmap": []}
+    store.windowed_quantiles(svc, qs)  # one-time numpy/solver warmup
+    for _ in range(40):
+        t0 = time.perf_counter()
+        est = store.windowed_quantiles(svc, qs)
+        lat["windowed_quantiles"].append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        burn = store.slo_burn(svc, objective=0.99)
+        lat["slo_burn"].append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        heat = store.latency_heatmap(svc, bands=12)
+        lat["latency_heatmap"].append(time.perf_counter() - t0)
+
+    def pctls(samples):
+        a = np.sort(samples)
+        return {"p50_ms": round(float(a[len(a) // 2]) * 1e3, 3),
+                "p99_ms": round(float(a[int(len(a) * 0.99)]) * 1e3, 3)}
+
+    # (d) exactness vs the raw span stream.
+    mine = [s for s in spans if (s.service_name or "") == svc]
+    exact_durs = np.sort([s.duration for s in mine
+                          if s.duration is not None])
+    rank_err = max(
+        abs(np.searchsorted(exact_durs, e) / max(len(exact_durs) - 1, 1)
+            - q)
+        for q, e in zip(qs, est))
+    exact_errors = sum(
+        1 for s in mine
+        if any(a.value == "error" for a in s.annotations))
+    widest = max(burn["windows"], key=lambda w: w["windowSeconds"])
+    counts_exact = (widest["total"] == len(mine)
+                    and widest["errors"] == exact_errors)
+    out = {
+        "spans": len(spans),
+        "window_seconds": config.window_seconds,
+        "window_buckets": config.window_buckets,
+        "window_off_spans_per_s": round(len(spans) / off_s, 1),
+        "window_on_spans_per_s": round(len(spans) / on_s, 1),
+        "arena_overhead_pct": round((on_s / off_s - 1.0) * 100.0, 2),
+        "mirror_bitwise_identical": bool(bitwise),
+        "live_cells": int(mir.window_live_cells()),
+        "heatmap_columns": len(heat["bucketStartsTs"]),
+        "burn_error_counts_exact": bool(counts_exact),
+        "quantile_rank_err": round(float(rank_err), 4),
+        "solver_rank_tol": win.SOLVER_RANK_TOL,
+        **{k: pctls(v) for k, v in lat.items()},
+    }
+    warm_on.close()
+    store.close()
+    return out
+
+
 def bench_checkpoint(store):
     """Checkpoint at bench scale (VERDICT r3 item 8): snapshot the
     streamed store, restore it, and require bit-identical answers to a
@@ -1569,6 +1703,17 @@ def main():
                 int(2e4) if args.smoke else int(2e5)),
             timeout_s=900, label="durability")
         emit("stream+queries+exactness+archive+pipeline+durability")
+        # Windowed analytics (r13 tentpole, aggregate/windows.py):
+        # arena fold overhead on the fused step, mirror-served
+        # quantile/burn/heatmap latency, bitwise + exactness checks.
+        # Bounded like its neighbors — a failure here must not strand
+        # the core phases.
+        detail["windowed_analytics"] = _bounded(
+            lambda: bench_windows(
+                int(2e4) if args.smoke else int(2e5)),
+            timeout_s=900, label="windows")
+        emit("stream+queries+exactness+archive+pipeline+durability"
+             "+windows")
         # Ingest roofline round 2 (r12 tentpole): spans/s per
         # (batch_spans, sort-path, scatter-path) arm — the evidence
         # the batch-escalation knee and the >=300k spans/s cert read

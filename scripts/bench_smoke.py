@@ -678,6 +678,146 @@ def run_ingest_structure() -> dict:
     }
 
 
+def run_windows() -> dict:
+    """Windowed-analytics phase (r13 tentpole), tier-1 gates:
+
+    (a) census arithmetic — the windowed arena's fused-step cost is
+        EXACTLY the gated bump (census.MAX_STEP_* = BASE + WINDOW_BUMP
+        with the window on; the window-off lowering at the BASE
+        counts, which is also the library-default lowering the main
+        stream gates), so the feature can't silently grow;
+    (b) mirror-vs-device BITWISE identity of all four window arrays
+        after a multi-bucket drive (incl. error spans), serial AND
+        pipelined;
+    (c) zero steady-state recompiles with the window update fused
+        (same drive twice through warmed shapes);
+    (d) the sketch-tier windowed reads (quantiles / burn / heatmap)
+        answer with zero device dispatches and the quantile lands
+        inside the documented solver rank tolerance vs the exact span
+        durations."""
+    import numpy as np
+
+    import jax
+
+    from zipkin_tpu.aggregate import windows as win
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+
+    cfg = dev.StoreConfig(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=128, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=512, rank_path="counting",
+        window_seconds=60, window_buckets=8,
+    )
+    rng = np.random.default_rng(42)
+    eps = [Endpoint(1 + i, 80, f"wsvc{i}") for i in range(4)]
+    base = 1_700_000_000_000_000
+
+    def gen(n, seed_off=0):
+        out = []
+        for i in range(n):
+            ep = eps[(i + seed_off) % 4]
+            t0 = base + int(rng.integers(0, 5 * 60_000_000))
+            d = int(rng.lognormal(7.0, 1.3)) + 1
+            anns = [Annotation(t0, "sr", ep),
+                    Annotation(t0 + d, "ss", ep)]
+            if i % 9 == 0:
+                anns.append(Annotation(t0 + 1, "error", ep))
+            out.append(Span(i // 3 + 1, f"wop{i % 4}", i + 1, None,
+                            tuple(anns), ()))
+        return out
+
+    spans = gen(600)
+
+    def drive(store, pipelined):
+        if pipelined:
+            store.start_pipeline(4)
+        for i in range(0, len(spans), 200):
+            store.apply(spans[i:i + 200])
+        if pipelined:
+            store.drain_pipeline()
+            store.stop_pipeline()
+
+    def win_state(store):
+        st = store.state
+        return jax.device_get(
+            (st.win_epoch, st.win_counts, st.win_sums, st.win_mm))
+
+    serial = TpuSpanStore(cfg)
+    drive(serial, False)
+    piped = TpuSpanStore(cfg)
+    drive(piped, True)
+    dev_arrays = win_state(serial)
+    mir = serial.sketch_mirror
+    mirror_bitwise = all(
+        np.array_equal(a, b) for a, b in zip(
+            dev_arrays,
+            (mir.win_epoch, mir.win_counts, mir.win_sums, mir.win_mm)))
+    piped_bitwise = all(
+        np.array_equal(a, b)
+        for a, b in zip(dev_arrays, win_state(piped)))
+
+    # (c) zero steady-state recompiles across a re-drive of warmed
+    # shapes with the window update fused into the step.
+    compiles0 = dev.compile_count()
+    redrive = TpuSpanStore(cfg)
+    drive(redrive, False)
+    recompiles = dev.compile_count() - compiles0
+
+    # (d) sketch-tier reads — pure host math; gate the solver's rank.
+    # p50 over warmed calls, matching the r11 sketch-tier gate: the
+    # first call pays one-time numpy/solver warmup, not serve cost.
+    est = serial.windowed_quantiles("wsvc1", [0.5, 0.99])
+    samples = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        serial.windowed_quantiles("wsvc1", [0.5, 0.99])
+        samples.append(time.perf_counter() - t0)
+    q_ms = sorted(samples)[len(samples) // 2] * 1e3
+    burn = serial.slo_burn("wsvc1", objective=0.99)
+    heat = serial.latency_heatmap("wsvc1", bands=6)
+    durs = np.sort([
+        s.duration for s in spans
+        if (s.service_name or "") == "wsvc1" and s.duration is not None
+    ])
+    rank_err = (abs(np.searchsorted(durs, est[0])
+                    / max(len(durs) - 1, 1) - 0.5)
+                if est else float("inf"))
+
+    # (a) census arithmetic: window-on vs window-off lowerings.
+    from zipkin_tpu.columnar.schema import SpanBatch
+
+    db = dev.make_device_batch(
+        SpanBatch.empty(0, 0, 0), name_lc_id=np.zeros(0, np.int32),
+        indexable=np.zeros(0, bool),
+        pad_spans=256, pad_anns=1024, pad_banns=512,
+    )
+    census_on = _count_ops(
+        dev.ingest_step.lower(dev.init_state(cfg), db).as_text())
+    census_off = _count_ops(dev.ingest_step.lower(
+        dev.init_state(cfg._replace(window_seconds=0)), db).as_text())
+
+    for s in (serial, piped, redrive):
+        s.close()
+    return {
+        "census_window_on": census_on,
+        "census_window_off": census_off,
+        "mirror_bitwise": bool(mirror_bitwise),
+        "pipelined_bitwise": bool(piped_bitwise),
+        "recompiles_steady_state": int(recompiles),
+        "windowed_quantile_ms": round(q_ms, 3),
+        "quantile_rank_err": round(float(rank_err), 4),
+        "solver_rank_tol": win.SOLVER_RANK_TOL,
+        "burn_total": burn["windows"][0]["total"],
+        "burn_errors": burn["windows"][0]["errors"],
+        "heatmap_columns": len(heat["bucketStartsTs"]),
+        "window_spans_folded": int(mir.win_spans_total),
+        "window_errors_folded": int(mir.win_errors_total),
+    }
+
+
 def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
     import numpy as np  # noqa: F401  (kept: smoke envs import-check it)
 
@@ -792,10 +932,14 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         "wal": run_wal(),
         "query": run_query(),
         "ingest_structure": run_ingest_structure(),
+        "windows": run_windows(),
+        # The main stream runs the library default (window arena OFF),
+        # so its step census gates at the BASE ceilings; the windows
+        # phase gates the window-on lowering at BASE + WINDOW_BUMP.
         "census_ceilings": {
-            "scatter": census.MAX_STEP_SCATTERS,
-            "sort": census.MAX_STEP_SORTS,
-            "gather": census.MAX_STEP_GATHERS,
+            "scatter": census.BASE_STEP_SCATTERS,
+            "sort": census.BASE_STEP_SORTS,
+            "gather": census.BASE_STEP_GATHERS,
         },
         "spans": total,
         "ingest_spans_per_s": round(total / dt, 1),

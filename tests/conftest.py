@@ -122,6 +122,13 @@ _SLOW_TESTS = {
     "test_crash_mid_seal_replays_capture_and_cold_tier",
     "test_crash_mid_seal_with_checkpoint",
     "test_clean_child_exits_zero",
+    # Windowed-analytics deep sweeps (tests/test_windows.py): tier-1
+    # keeps cell exactness, ring-wrap, boundary, solver, resync and
+    # API gates; the multi-lap fuzz sweep and the checkpoint
+    # round-trip ride the slow lane (bench_smoke's windows phase
+    # already smoke-gates mirror bitwise identity every tier-1 run).
+    "test_window_ring_wrap_deep_sweep",
+    "test_pre_rev14_checkpoint_restores_empty_arena",
 }
 
 

@@ -17,6 +17,9 @@ import sys
 
 from zipkin_tpu.store.census import (
     ARGSORT_STEP_SORTS,
+    BASE_STEP_GATHERS,
+    BASE_STEP_SCATTERS,
+    BASE_STEP_SORTS,
     MAX_MIRROR_DELTA_RATIO,
     MAX_STEP_GATHERS,
     MAX_STEP_SCATTERS,
@@ -38,10 +41,10 @@ def test_bench_smoke_json_and_op_ceilings():
     # The index-family step-count gate — measured WITH telemetry wired
     # (the store registers its obs metrics and the counter block is
     # fetched), so a device counter fetch that grew the step would
-    # trip here.
-    assert rec["step_scatters"] <= MAX_STEP_SCATTERS, rec
-    assert rec["step_sorts"] <= MAX_STEP_SORTS, rec
-    assert rec["step_gathers"] <= MAX_STEP_GATHERS, rec
+    # trip here. Default config = window arena off = BASE lowering.
+    assert rec["step_scatters"] <= BASE_STEP_SCATTERS, rec
+    assert rec["step_sorts"] <= BASE_STEP_SORTS, rec
+    assert rec["step_gathers"] <= BASE_STEP_GATHERS, rec
     # The telemetry counter block itself must lower as a pure read.
     tel = rec["telemetry"]
     assert tel["counter_block_scatters"] == 0
@@ -153,7 +156,33 @@ def test_bench_smoke_json_and_op_ceilings():
     assert ing["mirror_delta_ratio"] <= MAX_MIRROR_DELTA_RATIO, ing
     # The ceilings the smoke JSON carries must be the census module's
     # (one definition site — this test would catch a re-hard-coding).
+    # The main stream runs the library default (window arena OFF), so
+    # it carries the BASE ceilings.
     assert rec["census_ceilings"] == {
+        "scatter": BASE_STEP_SCATTERS, "sort": BASE_STEP_SORTS,
+        "gather": BASE_STEP_GATHERS,
+    }
+    # Windowed-analytics phase (r13 tentpole): the arena's fused-step
+    # cost is exactly the gated census bump (the window-off lowering
+    # stays at the BASE counts), mirror and device window cells are
+    # BITWISE identical through serial and pipelined drives, the
+    # window update adds zero steady-state recompiles, and the
+    # sketch-tier windowed quantile answers inside the documented
+    # solver rank tolerance with sub-10ms host-only latency.
+    w = rec["windows"]
+    assert w["census_window_on"] == {
         "scatter": MAX_STEP_SCATTERS, "sort": MAX_STEP_SORTS,
         "gather": MAX_STEP_GATHERS,
-    }
+    }, w
+    assert w["census_window_off"] == {
+        "scatter": BASE_STEP_SCATTERS, "sort": BASE_STEP_SORTS,
+        "gather": BASE_STEP_GATHERS,
+    }, w
+    assert w["mirror_bitwise"] is True, w
+    assert w["pipelined_bitwise"] is True, w
+    assert w["recompiles_steady_state"] == 0, w
+    assert w["quantile_rank_err"] <= w["solver_rank_tol"], w
+    assert w["windowed_quantile_ms"] < 10.0, w
+    assert w["burn_errors"] >= 1, w
+    assert w["heatmap_columns"] >= 1, w
+    assert w["window_spans_folded"] > 0, w

@@ -1,0 +1,570 @@
+"""Windowed Moments-sketch arena (aggregate/windows.py + the r13
+device/mirror/query vertical): cell-sum exactness vs a memory oracle,
+solver rank tolerance, bucket-boundary and ragged windows,
+epoch-stamped ring wrap, adopt_state resync, the pre-rev-14 checkpoint
+compat path, and the API JSON surface."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from zipkin_tpu.aggregate import windows as win
+from zipkin_tpu.models.span import (
+    Annotation,
+    BinaryAnnotation,
+    Endpoint,
+    Span,
+)
+from zipkin_tpu.store.device import StoreConfig
+from zipkin_tpu.store.tpu import TpuSpanStore
+
+BASE_US = 1_700_000_000_000_000
+BUCKET_S = 60
+BUCKET_US = BUCKET_S * 1_000_000
+
+EPS = [Endpoint(0x0A000001 + i, 80, f"svc{i}") for i in range(4)]
+
+
+def _cfg(**kw):
+    base = dict(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=64, max_annotation_values=128,
+        max_binary_keys=32, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=512, window_seconds=BUCKET_S,
+        window_buckets=8,
+    )
+    base.update(kw)
+    return StoreConfig(**base)
+
+
+def _span(i, ep, ts, dur, error=False, error_key=False):
+    anns = [Annotation(ts, "sr", ep), Annotation(ts + dur, "ss", ep)]
+    banns = []
+    if error:
+        anns.append(Annotation(ts + 1, "error", ep))
+    if error_key:
+        banns.append(BinaryAnnotation("error", b"true", 6, ep))
+    return Span(i // 3 + 1, f"op{i % 5}", i + 1, None, tuple(anns),
+                tuple(banns))
+
+
+def _gen_spans(n=400, seed=0, buckets=6, services=4):
+    rng = np.random.default_rng(seed)
+    spans = []
+    for i in range(n):
+        ep = EPS[i % services]
+        ts = BASE_US + int(rng.integers(0, buckets * BUCKET_US))
+        dur = int(rng.lognormal(7.0, 1.4)) + 1
+        spans.append(_span(i, ep, ts, dur, error=(i % 10 == 0),
+                           error_key=(i % 17 == 3)))
+    return spans
+
+
+def _oracle_cells(store, spans):
+    """Independent per-(service, final-live-bucket) cell sums from the
+    raw span objects, using the same quantization (the final arena
+    state is order-independent: a slot holds exactly the rows of its
+    max-ever bucket)."""
+    c = store.config
+    gamma = store.sketch_mirror.gamma
+    W = c.win_slots
+    rows = []
+    for s in spans:
+        svc_name = s.service_name
+        ts = s.first_timestamp
+        if svc_name is None or ts is None:
+            continue
+        svc = store.dicts.services.get(svc_name.lower())
+        if svc is None or svc >= c.max_services:
+            continue
+        err = (any(a.value == "error" for a in s.annotations)
+               or any(b.key == "error" for b in s.binary_annotations))
+        rows.append((svc, ts // (c.window_us), s.duration, err))
+    final_epoch = {}
+    for svc, b, dur, err in rows:
+        w = b % W
+        final_epoch[w] = max(final_epoch.get(w, -1), b)
+    cells = {}
+    for svc, b, dur, err in rows:
+        if final_epoch[b % W] != b:
+            continue  # overwritten by a newer bucket on the same slot
+        cell = cells.setdefault(
+            (svc, b), {"total": 0, "err": 0, "n": 0,
+                       "s": [0, 0, 0, 0], "xs": []})
+        cell["total"] += 1
+        cell["err"] += int(err)
+        if dur is not None and dur >= 0:
+            x = int(win.duration_x(
+                np.array([dur]), c.quantile_buckets, gamma)[0])
+            cell["n"] += 1
+            for k in range(4):
+                cell["s"][k] += x ** (k + 1)
+            cell["xs"].append(x)
+    return cells, final_epoch
+
+
+class TestCellExactness:
+    def test_cells_match_memory_oracle_bitwise(self):
+        store = TpuSpanStore(_cfg())
+        spans = _gen_spans()
+        store.apply(spans)
+        m = store.sketch_mirror
+        cells, final_epoch = _oracle_cells(store, spans)
+        W = store.config.win_slots
+        # Epoch stamps.
+        for w in range(W):
+            assert int(m.win_epoch[w]) == final_epoch.get(w, -1)
+        # Every oracle cell matches the mirror cell EXACTLY (integer
+        # sums — the Moments-sketch merge invariant), and occupied
+        # mirror cells are exactly the oracle's.
+        occupied = {
+            (svc, int(m.win_epoch[w]))
+            for svc in range(store.config.max_services)
+            for w in range(W)
+            if m.win_counts[svc, w, 0] > 0
+        }
+        assert occupied == set(cells)
+        for (svc, b), want in cells.items():
+            w = b % W
+            assert list(m.win_counts[svc, w]) == [
+                want["total"], want["err"], want["n"]]
+            assert list(m.win_sums[svc, w]) == want["s"]
+            if want["n"]:
+                assert -int(m.win_mm[svc, w, 0]) == min(want["xs"])
+                assert int(m.win_mm[svc, w, 1]) == max(want["xs"])
+
+    def test_mirror_matches_device_bitwise(self):
+        import jax
+
+        store = TpuSpanStore(_cfg())
+        store.apply(_gen_spans(seed=3))
+        m = store.sketch_mirror
+        st = store.state
+        dev_arrays = jax.device_get(
+            (st.win_epoch, st.win_counts, st.win_sums, st.win_mm))
+        for got, want in zip(
+                (m.win_epoch, m.win_counts, m.win_sums, m.win_mm),
+                dev_arrays):
+            np.testing.assert_array_equal(got, want)
+
+    def test_error_flags_both_conventions(self):
+        # One pad-512 apply (the file's shared launch shape): spans
+        # 0..39 carry the "error" ANNOTATION VALUE, 40..69 the "error"
+        # BINARY KEY, the rest are clean — both zipkin conventions
+        # count, nothing else does.
+        store = TpuSpanStore(_cfg())
+        spans = [
+            _span(3 * i, EPS[0], BASE_US + i, 100,
+                  error=(i < 40), error_key=(40 <= i < 70))
+            for i in range(400)
+        ]
+        store.apply(spans)
+        burn = store.slo_burn("svc0", windows_s=[3600],
+                              now_us=BASE_US + BUCKET_US)
+        assert burn["windows"][0]["total"] == 400
+        assert burn["windows"][0]["errors"] == 70
+
+
+class TestSolver:
+    def test_windowed_quantile_rank_tolerance(self):
+        """The documented solver gate: the maxent estimate's rank in
+        the TRUE duration distribution is within SOLVER_RANK_TOL of
+        the requested q (the Moments-sketch paper's metric)."""
+        # n=400 shares the pad-512 launch shape every other test in
+        # this file compiles — tier-1 pays ONE ingest compile here.
+        store = TpuSpanStore(_cfg())
+        spans = _gen_spans(n=400, seed=7)
+        store.apply(spans)
+        durs = np.sort([
+            s.duration for s in spans
+            if (s.service_name or "").lower() == "svc1"
+            and s.duration is not None
+        ])
+        for q in (0.5, 0.9, 0.99):
+            est = store.windowed_quantiles("svc1", [q])
+            assert est is not None
+            rank = np.searchsorted(durs, est[0]) / max(len(durs) - 1, 1)
+            assert abs(rank - q) <= win.SOLVER_RANK_TOL, (q, est, rank)
+
+    def test_point_mass_and_empty_cells(self):
+        store = TpuSpanStore(_cfg())
+        assert store.windowed_quantiles("svc0", [0.5]) is None
+        store.apply([_span(i, EPS[0], BASE_US + i, 5000)
+                     for i in range(10)])
+        est = store.windowed_quantiles("svc0", [0.5, 0.99])
+        gamma = store.sketch_mirror.gamma
+        # All durations in one coarse bucket → both quantiles at its
+        # midpoint, within the bucket's relative width.
+        assert est[0] == est[1]
+        assert abs(np.log(est[0] / 5000.0)) <= 2 * np.log(gamma) * (
+            1 << store.config.win_x_shift)
+
+
+class TestWindows:
+    def test_ragged_and_boundary_windows_match_oracle_counts(self):
+        """Bucket-boundary spans (ts exactly at k·bucket and k·bucket-1)
+        and ragged [start, end) extents: windowed totals equal the
+        oracle's whole-bucket expansion."""
+        store = TpuSpanStore(_cfg())
+        # Bucket-ALIGNED base so off = BUCKET_US - 1 stays in bucket b.
+        # 10 spans per (bucket, boundary offset) × (b+1) weights = 300
+        # spans → the file's shared pad-512 launch shape.
+        base = (BASE_US // BUCKET_US) * BUCKET_US
+        spans = []
+        i = 0
+        for b in range(4):
+            for off in (0, 1, BUCKET_US - 1):
+                for _ in range(10 * (b + 1)):
+                    spans.append(_span(
+                        i, EPS[0], base + b * BUCKET_US + off, 100))
+                    i += 1
+        store.apply(spans)
+        b0 = base // BUCKET_US
+        m = store.sketch_mirror
+        epoch, counts, sums, mm = m.window_row(
+            store.dicts.services.get("svc0"))
+        for lo_b, hi_b in ((0, 0), (0, 3), (1, 2), (2, 3), (3, 3)):
+            ws = win.merge_cells(epoch, counts, sums, mm,
+                                 b0 + lo_b, b0 + hi_b)
+            want = sum(30 * (b + 1) for b in range(lo_b, hi_b + 1))
+            assert ws.total == want, (lo_b, hi_b)
+            # Ragged µs extents snap to whole buckets: any sub-bucket
+            # offset inside the same bucket span answers identically.
+            est = store.windowed_quantiles(
+                "svc0", [0.5],
+                start_us=base + lo_b * BUCKET_US + 123,
+                end_us=base + hi_b * BUCKET_US + BUCKET_US - 7)
+            est2 = store.windowed_quantiles(
+                "svc0", [0.5],
+                start_us=base + lo_b * BUCKET_US,
+                end_us=base + (hi_b + 1) * BUCKET_US)
+            assert est == est2
+
+    def test_epoch_ring_wrap_reuses_stale_cells(self):
+        """Writing W + k distinct buckets wraps the ring: wrapped slots
+        self-clear (epoch advances, old cell content gone), totals
+        reflect only live buckets, and a late span for an overwritten
+        bucket is dropped — mirror and device agreeing bitwise."""
+        import jax
+
+        store = TpuSpanStore(_cfg(window_buckets=4))
+        W = 4
+        for b in range(W + 3):  # buckets 0..6; slots 0..2 wrapped
+            store.apply([
+                _span(10 * b + j, EPS[0],
+                      BASE_US + b * BUCKET_US + j, 1000 * (b + 1))
+                for j in range(b + 1)
+            ])
+        m = store.sketch_mirror
+        svc = store.dicts.services.get("svc0")
+        base_b = BASE_US // BUCKET_US
+        live = {int(e) - base_b for e in m.win_epoch if e >= 0}
+        assert live == {3, 4, 5, 6}
+        epoch, counts, sums, mm = m.window_row(svc)
+        for b in (3, 4, 5, 6):
+            ws = win.merge_cells(epoch, counts, sums, mm,
+                                 base_b + b, base_b + b)
+            assert ws.total == b + 1
+        # A late write for overwritten bucket 0 must be dropped.
+        before = counts.copy()
+        store.apply([_span(999, EPS[0], BASE_US + 5, 777)])
+        epoch2, counts2, _, _ = m.window_row(svc)
+        np.testing.assert_array_equal(counts2, before)
+        np.testing.assert_array_equal(epoch2, epoch)
+        st = store.state
+        got = jax.device_get(
+            (st.win_epoch, st.win_counts, st.win_sums, st.win_mm))
+        np.testing.assert_array_equal(got[0], m.win_epoch)
+        np.testing.assert_array_equal(got[1], m.win_counts)
+        np.testing.assert_array_equal(got[2], m.win_sums)
+        np.testing.assert_array_equal(got[3], m.win_mm)
+
+    def test_window_ring_wrap_deep_sweep(self):
+        """Slow lane: many laps over a small ring with varying batch
+        sizes and cross-bucket batches, re-gating bitwise mirror
+        identity and the live-set invariant each lap."""
+        import jax
+
+        store = TpuSpanStore(_cfg(window_buckets=4))
+        rng = np.random.default_rng(11)
+        i = 0
+        for lap in range(12):
+            spans = []
+            for _ in range(int(rng.integers(5, 40))):
+                b = lap * 2 + int(rng.integers(0, 3))
+                spans.append(_span(
+                    i, EPS[i % 4],
+                    BASE_US + b * BUCKET_US + int(rng.integers(
+                        0, BUCKET_US)),
+                    int(rng.lognormal(6, 1)) + 1,
+                    error=bool(rng.integers(0, 2))))
+                i += 1
+            store.apply(spans)
+            m = store.sketch_mirror
+            st = store.state
+            got = jax.device_get(
+                (st.win_epoch, st.win_counts, st.win_sums, st.win_mm))
+            np.testing.assert_array_equal(got[0], m.win_epoch)
+            np.testing.assert_array_equal(got[1], m.win_counts)
+            np.testing.assert_array_equal(got[2], m.win_sums)
+            np.testing.assert_array_equal(got[3], m.win_mm)
+
+
+class TestBurnAndHeatmap:
+    def test_slo_burn_matches_memory_oracle(self):
+        from zipkin_tpu.store.memory import InMemorySpanStore
+
+        store = TpuSpanStore(_cfg())
+        oracle = InMemorySpanStore()
+        spans = _gen_spans(n=300, seed=5, buckets=4)
+        store.apply(spans)
+        oracle.apply(spans)
+        # Bucket-aligned now: the sketch's whole-bucket windows then
+        # cover exactly the oracle's span-level [now - w, now).
+        now = (max(s.first_timestamp for s in spans) // BUCKET_US + 1
+               ) * BUCKET_US
+        for svc in ("svc0", "svc2"):
+            got = store.slo_burn(svc, objective=0.99,
+                                 windows_s=[60, 180, 3600], now_us=now)
+            want = oracle.slo_burn(svc, objective=0.99,
+                                   windows_s=[60, 180, 3600],
+                                   now_us=now)
+            assert got["windows"] == want["windows"], svc
+
+    def test_heatmap_grid_shape_and_mass(self):
+        store = TpuSpanStore(_cfg())
+        spans = _gen_spans(n=300, seed=9, buckets=5)
+        store.apply(spans)
+        hm = store.latency_heatmap("svc1", bands=8)
+        n_cols = len(hm["bucketStartsTs"])
+        assert n_cols == len(hm["cells"]) == len(hm["totals"])
+        assert len(hm["bandEdgesMicros"]) == len(hm["cells"][0]) + 1
+        assert hm["bucketStartsTs"] == sorted(hm["bucketStartsTs"])
+        edges = hm["bandEdgesMicros"]
+        assert edges == sorted(edges)
+        # Per-column solver mass re-normalizes to the cell's duration
+        # count (within float rounding of the pmf).
+        m = store.sketch_mirror
+        svc = store.dicts.services.get("svc1")
+        epoch, counts, _, _ = m.window_row(svc)
+        for col, ts0 in zip(hm["cells"], hm["bucketStartsTs"]):
+            b = ts0 // BUCKET_US
+            w = int(np.flatnonzero(epoch == b)[0])
+            assert abs(sum(col) - counts[w, 2]) <= 0.51
+
+
+class TestLifecycle:
+    def test_mirror_resync_after_adopt_state(self):
+        src = TpuSpanStore(_cfg())
+        spans = _gen_spans(n=200, seed=13)
+        src.apply(spans)
+        # The adopting store shares the codec: adoption moves device
+        # state, not dictionaries (the bench streaming pattern).
+        dst = TpuSpanStore(_cfg(), codec=src.codec)
+        dst.adopt_state(src.state, spans_written=len(spans))
+        assert not dst.sketch_mirror.warm
+        # First windowed read resyncs the window twins with the other
+        # aggregates, exactly equal to the source mirror's cells.
+        got = dst.windowed_quantiles("svc1", [0.5, 0.99])
+        want = src.windowed_quantiles("svc1", [0.5, 0.99])
+        assert got == want
+        for a, b in zip(dst.sketch_mirror.window_arrays(),
+                        src.sketch_mirror.window_arrays()):
+            np.testing.assert_array_equal(a, b)
+
+    def test_window_disabled_store_still_serves(self):
+        store = TpuSpanStore(_cfg(window_seconds=0))
+        store.apply(_gen_spans(n=60))
+        assert store.windowed_quantiles("svc0", [0.5]) is None
+        assert store.slo_burn("svc0") is None
+        assert store.latency_heatmap("svc0") is None
+        # Lifetime quantiles still serve.
+        assert store.service_duration_quantiles("svc0", [0.5])
+
+    def test_rev14_checkpoint_and_wal_replay_carry_cells(
+            self, tmp_path):
+        """The ISSUE acceptance ride: window cells survive a rev-14
+        checkpoint + WAL tail replay BITWISE — the recovered arena
+        (device leaves AND resynced mirror twins) equals an uncrashed
+        oracle's, and windowed answers match."""
+        from zipkin_tpu import checkpoint
+        from zipkin_tpu.testing.crash import states_bitwise_equal
+        from zipkin_tpu.wal import WriteAheadLog, recover
+
+        spans = _gen_spans(n=400, seed=29)
+        oracle = TpuSpanStore(_cfg())
+        oracle.apply(spans[:200])
+        oracle.apply(spans[200:])
+
+        store = TpuSpanStore(_cfg())
+        wal = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+        store.attach_wal(wal)
+        store.apply(spans[:200])
+        checkpoint.save(store, str(tmp_path / "ckpt"))  # rev 14 leaves
+        store.apply(spans[200:])  # the replayed tail
+        wal.sync()
+        del store  # crash: HBM gone, snapshot + log survive
+
+        wal2 = WriteAheadLog(str(tmp_path / "wal"), fsync="off")
+        rec, _ = recover(str(tmp_path / "ckpt"), wal2)
+        try:
+            assert states_bitwise_equal(oracle.state, rec.state)
+            m = rec.ensure_sketch_mirror()
+            for a, b in zip(m.window_arrays(),
+                            oracle.sketch_mirror.window_arrays()):
+                np.testing.assert_array_equal(a, b)
+            assert (rec.windowed_quantiles("svc1", [0.5, 0.99])
+                    == oracle.windowed_quantiles("svc1", [0.5, 0.99]))
+            burn_r = rec.slo_burn("svc1", objective=0.99)
+            burn_o = oracle.slo_burn("svc1", objective=0.99)
+            assert burn_r == burn_o
+        finally:
+            wal2.close()
+
+    def test_pre_rev14_checkpoint_restores_empty_arena(self, tmp_path):
+        """Compat: a snapshot written before revision 14 (no win_*
+        leaves, no window config keys) restores with an EMPTY arena at
+        the daemon's flag geometry (checkpoint.load config_defaults —
+        meta keys always win, absent keys fill from the flags), and
+        post-restore ingest populates it."""
+        from zipkin_tpu import checkpoint
+
+        store = TpuSpanStore(_cfg())
+        store.apply(_gen_spans(n=120, seed=21))
+        path = os.path.join(str(tmp_path), "ckpt")
+        checkpoint.save(store, path)
+        # Doctor the snapshot into pre-14 shape.
+        state_file = os.path.join(path, "state.npz")
+        data = dict(np.load(state_file))
+        for k in list(data):
+            if k.startswith("win_"):
+                del data[k]
+        np.savez(state_file, **data)
+        meta_file = os.path.join(path, "meta.json")
+        with open(meta_file) as f:
+            meta = json.load(f)
+        meta["revision"] = 13
+        for k in ("window_seconds", "window_buckets"):
+            meta["config"].pop(k, None)
+        meta["slab_crc32"] = {
+            k: v for k, v in (meta.get("slab_crc32") or {}).items()
+            if not k.startswith("win_")
+        }
+        with open(meta_file, "w") as f:
+            json.dump(meta, f)
+        # The daemon restore path: flag geometry fills the missing
+        # window keys; without defaults the arena stays disabled (the
+        # snapshot's config governs).
+        plain = checkpoint.load(path)
+        try:
+            assert not plain.config.window_enabled
+            assert plain.windowed_quantiles("svc0", [0.5]) is None
+        finally:
+            plain.close()
+        restored = checkpoint.load(path, config_defaults={
+            "window_seconds": BUCKET_S, "window_buckets": 8})
+        try:
+            assert restored.config.window_enabled
+            m = restored.ensure_sketch_mirror()
+            assert (m.win_epoch == -1).all()
+            assert not m.win_counts.any()
+            # Lifetime aggregates survived; the arena only covers
+            # post-restore ingest.
+            assert restored.windowed_quantiles("svc0", [0.5]) is None
+            assert restored.service_duration_quantiles("svc0", [0.5])
+            restored.apply(_gen_spans(n=30, seed=22))
+            assert restored.windowed_quantiles("svc0", [0.5])
+        finally:
+            restored.close()
+
+
+class TestQuerySurface:
+    def test_engine_and_api_routes(self):
+        from zipkin_tpu.api.server import ApiServer
+        from zipkin_tpu.query.service import QueryService
+
+        store = TpuSpanStore(_cfg())
+        store.apply(_gen_spans(n=200, seed=17))
+        q = QueryService(store)
+        try:
+            api = ApiServer(q, collector=None)
+            code, body = api.handle("GET", "/api/windowed_quantiles", {
+                "serviceName": "svc1", "q": "0.5,0.99"})
+            assert code == 200 and body["durationsMicro"] is not None
+            json.dumps(body)
+            code, body = api.handle("GET", "/api/slo_burn", {
+                "serviceName": "svc1", "objective": "0.99",
+                "windows": "60,3600"})
+            assert code == 200
+            assert [w["windowSeconds"] for w in body["windows"]] == [
+                60, 3600]
+            json.dumps(body)
+            code, body = api.handle("GET", "/api/latency_heatmap", {
+                "serviceName": "svc1", "bands": "6"})
+            assert code == 200 and body["cells"]
+            json.dumps(body)
+            # Geometry echoed at /vars, read-only.
+            code, body = api.handle("GET", "/vars/windowSeconds", {})
+            assert (code, body) == (200, {"windowSeconds": BUCKET_S})
+            code, body = api.handle("GET", "/vars/windowBuckets", {})
+            assert (code, body) == (200, {"windowBuckets": 8})
+            code, _ = api.handle("POST", "/vars/windowSeconds", {},
+                                 b"30")
+            assert code == 400
+            # Unknown service answers null, not 500.
+            code, body = api.handle("GET", "/api/windowed_quantiles", {
+                "serviceName": "nosuch"})
+            assert (code, body["durationsMicro"]) == (200, None)
+        finally:
+            q.close()
+
+    def test_memory_store_exact_scan_parity(self):
+        from zipkin_tpu.api.server import ApiServer
+        from zipkin_tpu.query.service import QueryService
+        from zipkin_tpu.store.memory import InMemorySpanStore
+
+        store = InMemorySpanStore()
+        spans = _gen_spans(n=100, seed=19)
+        store.apply(spans)
+        q = QueryService(store)
+        try:
+            api = ApiServer(q, collector=None)
+            code, body = api.handle("GET", "/api/windowed_quantiles", {
+                "serviceName": "svc0"})
+            assert code == 200 and body["durationsMicro"] is not None
+            code, body = api.handle("GET", "/api/slo_burn", {
+                "serviceName": "svc0"})
+            assert code == 200 and body["windows"]
+            code, body = api.handle("GET", "/api/latency_heatmap", {
+                "serviceName": "svc0"})
+            assert code == 200 and body["cells"]
+        finally:
+            q.close()
+
+    def test_sketch_tier_counts_and_window_sketch(self):
+        """Windowed reads are sketch-tier: they bump the sketch-answer
+        counter and the zipkin_window_query_seconds family, never the
+        dispatch sketch."""
+        from zipkin_tpu import obs
+        from zipkin_tpu.query.engine import QueryEngine
+
+        store = TpuSpanStore(_cfg())
+        store.apply(_gen_spans(n=200, seed=23))
+        reg = obs.Registry()
+        eng = QueryEngine(store, registry=reg)
+        try:
+            before = eng.c_sketch.value
+            eng.windowed_quantiles("svc0", [0.5])
+            eng.slo_burn("svc0")
+            eng.latency_heatmap("svc0")
+            assert eng.c_sketch.value == before + 3
+            fam = reg.get("zipkin_window_query_seconds")
+            text = reg.render_text()
+            assert fam is not None
+            assert 'endpoint="windowed_quantiles"' in text
+            assert 'endpoint="slo_burn"' in text
+            assert 'endpoint="latency_heatmap"' in text
+        finally:
+            eng.close()

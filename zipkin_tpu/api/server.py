@@ -186,6 +186,27 @@ class ApiServer:
                 lambda: coal.window_s * 1000.0,
                 lambda v: setattr(coal, "window_s", float(v) / 1000.0),
             )
+        # Windowed-arena geometry echo (the daemon's --window-seconds /
+        # --window-buckets): READ-ONLY — the grid is static device
+        # state; changing it means a new store.
+        def _static(_v):
+            raise QueryException(
+                "window geometry is static store state "
+                "(restart with --window-seconds/--window-buckets)")
+
+        backing = getattr(query.store, "hot", query.store)
+        store_cfg = getattr(backing, "config", None)
+        if store_cfg is not None and hasattr(store_cfg,
+                                             "window_seconds"):
+            self.vars["windowSeconds"] = (
+                lambda: store_cfg.window_seconds, _static)
+            self.vars["windowBuckets"] = (
+                lambda: store_cfg.window_buckets, _static)
+        elif hasattr(backing, "window_seconds"):
+            # Scan backends (memory store): bucket width only — the
+            # exact scan has no ring, so no windowBuckets to echo.
+            self.vars["windowSeconds"] = (
+                lambda: backing.window_seconds, _static)
 
     # -- dispatch -------------------------------------------------------
 
@@ -309,6 +330,12 @@ class ApiServer:
                 if any(v != v for v in vals):
                     vals = None
             return 200, {"quantiles": qs, "durationsMicro": vals}
+        if path == "/api/windowed_quantiles":
+            return self._windowed_quantiles(params)
+        if path == "/api/slo_burn":
+            return self._slo_burn(params)
+        if path == "/api/latency_heatmap":
+            return self._latency_heatmap(params)
         if path == "/api/span_durations":
             return self._span_durations(params)
         if path == "/api/service_names_to_trace_ids":
@@ -452,6 +479,63 @@ class ApiServer:
         if span_name == "all":
             span_name = None
         return time_stamp, params.get("serviceName"), span_name
+
+    @staticmethod
+    def _opt_int(params, *keys):
+        for k in keys:
+            raw = params.get(k)
+            if raw is not None and raw != "":
+                return int(raw)
+        return None
+
+    def _windowed_quantiles(self, params):
+        """Windowed latency quantiles off the (service × time-bucket)
+        Moments-sketch cells (docs/OBSERVABILITY.md): any [startTs,
+        endTs) µs window answers as a cell-sum + one Moments solve —
+        no segment scan, no device dispatch. null durations = no
+        duration-carrying span in the window (or no arena)."""
+        qs = [float(x) for x in
+              params.get("q", "0.5,0.95,0.99").split(",")]
+        vals = self.query.get_windowed_quantiles(
+            _require(params, "serviceName"), qs,
+            start_us=self._opt_int(params, "startTs", "startTime"),
+            end_us=self._opt_int(params, "endTs", "endTime"))
+        if vals is not None:
+            vals = [round(v, 1) for v in vals]
+            if any(v != v for v in vals):
+                vals = None
+        return 200, {"quantiles": qs, "durationsMicro": vals}
+
+    def _slo_burn(self, params):
+        """Multi-window error-budget burn rate: per lookback window
+        (seconds, comma list), error rate over the windowed cells'
+        error/total counts divided by the budget (1 - objective)."""
+        windows = params.get("windows")
+        windows_s = ([int(x) for x in windows.split(",") if x]
+                     if windows else None)
+        objective = params.get("objective")
+        out = self.query.get_slo_burn(
+            _require(params, "serviceName"),
+            objective=float(objective) if objective else None,
+            windows_s=windows_s,
+            now_us=self._opt_int(params, "nowTs"))
+        if out is None:
+            return 200, {"windows": None}
+        return 200, out
+
+    def _latency_heatmap(self, params):
+        """Service × time × duration-band grid from the windowed
+        cells: one column per live time bucket, log-spaced duration
+        bands, per-cell mass from the Moments solve."""
+        bands = params.get("bands")
+        out = self.query.get_latency_heatmap(
+            _require(params, "serviceName"),
+            start_us=self._opt_int(params, "startTs", "startTime"),
+            end_us=self._opt_int(params, "endTs", "endTime"),
+            bands=int(bands) if bands else None)
+        if out is None:
+            return 200, {"cells": None}
+        return 200, out
 
     def _span_durations(self, params):
         """getSpanDurations (zipkinQuery.thrift) over HTTP: durations
@@ -600,7 +684,8 @@ _KNOWN_ROUTES = frozenset((
     "/api/v1/spans", "/api/top_annotations", "/api/top_kv_annotations",
     "/api/quantiles", "/api/dependencies", "/api/traces_exist",
     "/api/span_durations", "/api/service_names_to_trace_ids",
-    "/api/data_ttl", "/scribe",
+    "/api/data_ttl", "/api/windowed_quantiles", "/api/slo_burn",
+    "/api/latency_heatmap", "/scribe",
 ))
 
 

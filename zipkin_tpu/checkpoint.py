@@ -72,7 +72,17 @@ _PINS_FILE = "pins.pkl"
 #    rotted slab fails fast instead of feeding garbage into
 #    device_put. Pre-13 snapshots restore exactly as before (clocks
 #    re-seeded, no CRC check); pre-13 loaders ignore both keys.
-_REVISION = 13
+# 14: windowed Moments-sketch arena (aggregate/windows.py): four new
+#    state leaves — win_epoch / win_counts / win_sums / win_mm, the
+#    (service × ring-indexed time bucket) integer cell grid — ride the
+#    generic leaf save/restore. Pre-14 snapshots simply lack the keys,
+#    so they restore with an EMPTY arena at init defaults (windowed
+#    answers cover post-restore ingest only — correct, since the ring
+#    retains at most window_seconds × window_buckets anyway); the
+#    sketch-mirror cold resync below already re-adopts the window
+#    twins with the other aggregates. Pre-14 loaders drop the unknown
+#    leaves via the `known` filter.
+_REVISION = 14
 _SEGMENTS_DIR = "segments"
 
 
@@ -584,19 +594,29 @@ def exists(path) -> bool:
                            or os.path.isdir(path + ".old"))
 
 
-def load(path: str, mesh=None):
+def load(path: str, mesh=None, config_defaults=None):
     """Restore a store from a snapshot directory (falling back to the
     ``.old`` snapshot if a save crashed mid-swap).
 
     Single-device snapshots restore a TpuSpanStore. Sharded snapshots
     (saved from a ShardedSpanStore) restore a ShardedSpanStore over
     ``mesh`` — or a mesh built from the first n visible devices when
-    not given; the shard count must match the snapshot's."""
+    not given; the shard count must match the snapshot's.
+
+    ``config_defaults`` fills config keys the snapshot's meta does NOT
+    carry (a knob newer than the snapshot's revision) — keys present
+    in the meta always win, since the saved leaves were shaped by
+    them. The daemon passes its --window-seconds/--window-buckets here
+    so a pre-rev-14 snapshot restores with an EMPTY window arena at
+    the flag geometry instead of silently disabling the feature."""
     if not os.path.isdir(path) and os.path.isdir(path + ".old"):
         path = path + ".old"
     with open(os.path.join(path, _META_FILE)) as f:
         meta = json.load(f)
-    config = dev.StoreConfig(**meta["config"])
+    cfg_map = dict(meta["config"])
+    for k, v in (config_defaults or {}).items():
+        cfg_map.setdefault(k, v)
+    config = dev.StoreConfig(**cfg_map)
 
     dicts = DictionarySet.__new__(DictionarySet)
     from zipkin_tpu.columnar.dictionary import Dictionary

@@ -50,6 +50,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "are bitwise-identical; auto picks the "
                         "counting sort when its scratch fits — "
                         "docs/PERFORMANCE.md)")
+    p.add_argument("--window-seconds", type=int, default=60,
+                   help="windowed-analytics time-bucket width for the "
+                        "(service × time) Moments-sketch arena behind "
+                        "/api/windowed_quantiles, /api/slo_burn and "
+                        "/api/latency_heatmap (0 disables the arena; "
+                        "echoed at /vars/windowSeconds — "
+                        "docs/OBSERVABILITY.md)")
+    p.add_argument("--window-buckets", type=int, default=64,
+                   help="windowed-analytics ring length: retention is "
+                        "window_seconds × window_buckets of cells per "
+                        "service; stale slots self-clear on reuse "
+                        "(echoed at /vars/windowBuckets)")
     p.add_argument("--sample-rate", type=float, default=1.0)
     p.add_argument("--adaptive-target", type=float, default=0.0,
                    help="target stored spans/minute; 0 disables adaptive")
@@ -135,7 +147,13 @@ def build_app(args):
             # FRESH after a crashed save would replay the WAL tail
             # against empty dictionaries (lineage error at best,
             # silent loss of checkpoint-covered spans at worst).
-            store = checkpoint.load(args.checkpoint)
+            # config_defaults: a pre-rev-14 snapshot (no window keys)
+            # restores with an EMPTY window arena at the flag
+            # geometry; a rev-14+ snapshot's saved geometry wins.
+            store = checkpoint.load(args.checkpoint, config_defaults={
+                "window_seconds": args.window_seconds,
+                "window_buckets": args.window_buckets,
+            })
             n = getattr(store, "n", 0)
             if args.shards and n != args.shards:
                 raise SystemExit(
@@ -147,6 +165,11 @@ def build_app(args):
             from zipkin_tpu.store.memory import InMemorySpanStore
 
             store = InMemorySpanStore()
+            # Exact-scan windowed analytics use the same bucket width
+            # the device arena would (0 keeps the 60s default — the
+            # scan path has no arena to disable).
+            if args.window_seconds > 0:
+                store.window_seconds = args.window_seconds
         elif args.shards:
             import jax
             import numpy as np
@@ -163,6 +186,13 @@ def build_app(args):
                 )
             mesh = Mesh(np.array(devices[:args.shards]),
                         axis_names=("shard",))
+            # Windowed analytics stays OFF on the sharded store: it
+            # has no windowed read path (no sketch mirror, no
+            # cross-shard cell merge) and the sharded encode never
+            # computes error flags — enabling the arena would spend
+            # the fused-step census bump on unreadable cells.
+            # Per-shard windowed analytics is an open item (like the
+            # per-shard WAL).
             store = ShardedSpanStore(
                 mesh, StoreConfig(
                     capacity=args.capacity,
@@ -180,6 +210,8 @@ def build_app(args):
                 batch_spans=args.batch_spans,
                 use_pallas=args.use_pallas,
                 rank_path=args.rank_path,
+                window_seconds=args.window_seconds,
+                window_buckets=args.window_buckets,
             ))
     if args.cold_tier:
         if hasattr(store, "archive"):

@@ -72,6 +72,17 @@ DEFAULT_COALESCE_WINDOW_S = 0.002
 _MISS = object()
 
 
+def _copy_json(v):
+    """Cheap deep copy for the JSON trees the windowed endpoints
+    return (dicts/lists of scalars) — cache hits must never alias a
+    mutable value a caller can corrupt (the r11 quantiles lesson)."""
+    if isinstance(v, dict):
+        return {k: _copy_json(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_copy_json(x) for x in v]
+    return v
+
+
 class _ResultCache:
     """Bounded LRU over ((method, args...), frontier) keys. Entries at
     a superseded frontier can never be served (the lookup key carries
@@ -141,6 +152,12 @@ class QueryEngine:
             "zipkin_query_sketch_answers_total",
             "Reads answered from host-mirrored sketches "
             "(zero device round-trips)"))
+        self.h_window = reg.register(obs.LatencySketch(
+            "zipkin_window_query_seconds",
+            "Windowed-analytics serve latency by endpoint "
+            "(windowed_quantiles / slo_burn / latency_heatmap — "
+            "sketch-tier: mirror cells + Moments solve, no device)",
+            labelnames=("endpoint",)))
         self.executor = ResidentCoalescer(
             store, window_s=window_s, registry=reg,
             dispatch_timer=self.h_dispatch.observe)
@@ -425,6 +442,68 @@ class QueryEngine:
         self.c_sketch.inc()
         self._serve("sketch", t0)
         return est
+
+    # -- sketch tier: windowed analytics ---------------------------------
+    # (aggregate/windows.py): the hot store's mirror answers windowed
+    # quantiles / burn rates / heatmaps from the (service ×
+    # time-bucket) Moments-sketch cells — host math only. Backends
+    # without the arena (memory/sql) fall back to their own exact-scan
+    # implementations through the frontier cache; stores with neither
+    # answer None.
+
+    def _window_call(self, endpoint: str, cache_key: tuple, args: tuple,
+                     kwargs: dict, copy=lambda v: v):
+        t0 = time.perf_counter()
+        hot = self.hot
+        fn = getattr(hot, endpoint, None)
+        if fn is not None and hasattr(hot, "ensure_sketch_mirror"):
+            out = fn(*args, **kwargs)
+            if out is None:
+                # Disabled arena / unknown service: a null body is not
+                # a sketch answer — don't inflate the sketch counters.
+                return None
+            self.c_sketch.inc()
+            self.h_window.labels(endpoint=endpoint).observe(
+                time.perf_counter() - t0)
+            self._serve("sketch", t0)
+            return out
+        store_fn = getattr(self.store, endpoint, None)
+        if store_fn is None:
+            return None
+        out = self._cached(cache_key,
+                           lambda: store_fn(*args, **kwargs),
+                           copy=lambda v: v if v is None else copy(v))
+        if out is not None:
+            self.h_window.labels(endpoint=endpoint).observe(
+                time.perf_counter() - t0)
+        return out
+
+    def windowed_quantiles(self, service: str, qs,
+                           start_us=None, end_us=None):
+        qs = list(qs)
+        return self._window_call(
+            "windowed_quantiles",
+            ("win_q", service, tuple(qs), start_us, end_us),
+            (service, qs), {"start_us": start_us, "end_us": end_us},
+            copy=list)
+
+    def slo_burn(self, service: str, objective=None, windows_s=None,
+                 now_us=None):
+        key = ("win_burn", service, objective,
+               tuple(windows_s) if windows_s else None, now_us)
+        return self._window_call(
+            "slo_burn", key, (service,),
+            {"objective": objective, "windows_s": windows_s,
+             "now_us": now_us}, copy=_copy_json)
+
+    def latency_heatmap(self, service: str, start_us=None, end_us=None,
+                        bands=None):
+        return self._window_call(
+            "latency_heatmap",
+            ("win_heat", service, start_us, end_us, bands),
+            (service,),
+            {"start_us": start_us, "end_us": end_us, "bands": bands},
+            copy=_copy_json)
 
     # -- lifecycle -------------------------------------------------------
 

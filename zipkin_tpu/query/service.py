@@ -261,6 +261,28 @@ class QueryService:
             return None
         return self.engine.service_duration_quantiles(service, list(qs))
 
+    # -- windowed analytics (aggregate/windows.py) ----------------------
+    # Time-scoped latency/error analytics off the windowed
+    # Moments-sketch arena — the engine's sketch tier on device
+    # stores, the backend's exact scan elsewhere; None when neither
+    # can serve.
+
+    def get_windowed_quantiles(self, service: str, qs,
+                               start_us=None, end_us=None):
+        return self.engine.windowed_quantiles(
+            service, list(qs), start_us=start_us, end_us=end_us)
+
+    def get_slo_burn(self, service: str, objective=None,
+                     windows_s=None, now_us=None):
+        return self.engine.slo_burn(
+            service, objective=objective, windows_s=windows_s,
+            now_us=now_us)
+
+    def get_latency_heatmap(self, service: str, start_us=None,
+                            end_us=None, bands=None):
+        return self.engine.latency_heatmap(
+            service, start_us=start_us, end_us=end_us, bands=bands)
+
     def set_trace_time_to_live(self, trace_id: int, ttl_s: float) -> None:
         self.store.set_time_to_live(trace_id, ttl_s)
 

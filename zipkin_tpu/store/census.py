@@ -20,15 +20,40 @@ History of the measured counts at the smoke shapes:
   ``stablehlo.sort`` the index write owned. The argsort path remains
   selectable (``StoreConfig.rank_path``) and bitwise-identical; its
   lowering sits at ARGSORT_STEP_SORTS.
+- r13 windowed arena:     +5 scatters / +0 sorts / +2 gathers — the
+  EXPLICIT GATED BUMP that buys the windowed Moments-sketch
+  (service × time-bucket) cell grid inside the fused step
+  (aggregate/windows.py) when ``window_seconds > 0``: +2 scatters
+  +1 gather for the exact epoch plane-war, +1 i32 count scatter (3P
+  rows), +1 i64 power-sum scatter (4P rows — the only
+  serialized-class scatter the feature adds), +1 i32 min/max
+  scatter-max (2P rows), +1 gather for the live-epoch check. The
+  arena is OPT-IN at the library layer (``StoreConfig`` default 0 —
+  the daemon turns it on via ``--window-seconds``), so the BASE
+  lowering stays 95/4/79 and the window-on lowering sits exactly at
+  BASE + WINDOW_BUMP (bench_smoke's windows phase gates both).
 
-Raise a ceiling only with a NOTES entry explaining what bought the
+Raise a ceiling only with a note here explaining what bought the
 extra launches.
 """
 
-# Fused-step ceilings (the tier-1 gate, tests/test_bench_smoke.py).
-MAX_STEP_SCATTERS = 95
-MAX_STEP_SORTS = 4
-MAX_STEP_GATHERS = 79
+# Fused-step BASE ceilings: the default (window-off) lowering, gated
+# in tier-1 against the main smoke stream (tests/test_bench_smoke.py).
+BASE_STEP_SCATTERS = 95
+BASE_STEP_SORTS = 4
+BASE_STEP_GATHERS = 79
+
+# The r13 windowed-arena bump (window_seconds > 0): the gated extra
+# launches the feature is allowed to spend inside the fused step.
+WINDOW_BUMP_SCATTERS = 5
+WINDOW_BUMP_GATHERS = 2
+
+# Overall ceilings — the window-on lowering (every optional path
+# engaged); bench_smoke's windows phase gates the on-lowering at
+# EXACTLY these counts.
+MAX_STEP_SCATTERS = BASE_STEP_SCATTERS + WINDOW_BUMP_SCATTERS
+MAX_STEP_SORTS = BASE_STEP_SORTS
+MAX_STEP_GATHERS = BASE_STEP_GATHERS + WINDOW_BUMP_GATHERS
 
 # The argsort rank path's sort count — the pre-r12 ceiling, still the
 # expected lowering when rank_path="argsort" (or the wm_shift == 0 /

@@ -47,6 +47,7 @@ from zipkin_tpu.ops.hashing import dev_split64
 
 I64_MAX = np.int64(2**63 - 1)
 I64_MIN = np.int64(-(2**63))
+I32_MIN = np.int32(-(2**31))
 NO_TS = -1
 
 
@@ -133,6 +134,23 @@ class StoreConfig(NamedTuple):
     # BITWISE-identical (tests/test_rank_paths.py fuzzes this), so the
     # choice is pure perf policy and may vary per launch shape.
     rank_path: str = "auto"
+    # Windowed Moments-sketch analytics arena (r13,
+    # aggregate/windows.py): a dense [S, W, k] grid of INTEGER
+    # Moments-sketch cells keyed by (service, time bucket) — per cell
+    # the (total, error, duration) count triple, the power sums
+    # Σx..Σx⁴ of the quantized log-duration x, and (min, max) of x.
+    # Time buckets are ring-indexed with per-slot epoch stamps, so any
+    # ad-hoc window is a cell-sum and stale slots self-clear on reuse.
+    # window_seconds is the bucket width; window_buckets is the ring
+    # length W, giving window_seconds * window_buckets of windowed
+    # retention. OPT-IN at the library layer (default 0 = the arena's
+    # step update lowers out entirely and the state arrays shrink to a
+    # [S, 1, k] stub so the checkpoint schema stays uniform) — the
+    # daemon enables it by default via --window-seconds (example.py),
+    # and the census bump it spends inside the fused step is gated in
+    # store/census.py (BASE vs BASE + WINDOW_BUMP lowerings).
+    window_seconds: int = 0
+    window_buckets: int = 64
 
     @property
     def tab_slots(self) -> int:
@@ -262,6 +280,33 @@ class StoreConfig(NamedTuple):
         return rows[self.N_CAND_FAMILIES:], total_b, total_s
 
     TR_SPAN, TR_ANN, TR_BANN = range(3)
+
+    # -- windowed analytics arena geometry --------------------------------
+
+    @property
+    def window_us(self) -> int:
+        return int(self.window_seconds) * 1_000_000
+
+    @property
+    def window_enabled(self) -> bool:
+        return self.window_seconds > 0 and self.window_buckets > 0
+
+    @property
+    def win_slots(self) -> int:
+        """Allocated ring length: the configured ring when the arena
+        is enabled, a 1-slot stub otherwise (a disabled arena keeps a
+        well-formed state schema without paying [S, W, k] memory)."""
+        return max(1, self.window_buckets) if self.window_enabled else 1
+
+    @property
+    def win_x_shift(self) -> int:
+        """Quantization shift: fine histogram bucket index >> shift
+        keeps x < 2^MAX_X_BITS, bounding the int64 Σx⁴ cell sums.
+        Delegates to the ONE definition site (aggregate.windows, the
+        mirror's twin) so device and mirror can never disagree."""
+        from zipkin_tpu.aggregate.windows import win_x_shift
+
+        return win_x_shift(self.quantile_buckets)
 
 
 def _next_pow2_int(n: int) -> int:
@@ -708,6 +753,17 @@ class StoreState:
     cms_trace_spans: jnp.ndarray  # [depth, width] i32 — spans per trace
     ts_min: jnp.ndarray  # scalar i64 — earliest ts seen (ingest wall)
     ts_max: jnp.ndarray  # scalar i64
+    # Windowed Moments-sketch arena (aggregate/windows.py): dense
+    # (service × ring-indexed time bucket) integer cells updated inside
+    # the fused step. win_epoch[w] stamps the ABSOLUTE time bucket a
+    # slot currently holds (-1 = never used); a newer bucket landing
+    # on the slot zeroes every service's cell row first (stale cells
+    # self-clear, no sweep). All fields are integers accumulated by
+    # scatter-add/-max so the host mirror twins match BITWISE.
+    win_epoch: jnp.ndarray  # [W] i64 — absolute bucket per slot; -1 empty
+    win_counts: jnp.ndarray  # [S, W, 3] i32 — (total, err, n_duration)
+    win_sums: jnp.ndarray  # [S, W, 4] i64 — Σx, Σx², Σx³, Σx⁴
+    win_mm: jnp.ndarray  # [S, W, 2] i32 — (max(-x), max(x)); I32_MIN empty
     counters: Dict[str, jnp.ndarray] = field(default_factory=dict)
 
     _FIELDS = (
@@ -725,7 +781,8 @@ class StoreState:
         "ann_poison", "key_tab", "key_wm",
         "svc_hist", "svc_span_counts", "ann_svc_counts",
         "name_presence", "ann_value_counts", "bann_key_counts",
-        "hll_traces", "cms_trace_spans", "ts_min", "ts_max", "counters",
+        "hll_traces", "cms_trace_spans", "ts_min", "ts_max",
+        "win_epoch", "win_counts", "win_sums", "win_mm", "counters",
     )
 
     def tree_flatten(self):
@@ -822,6 +879,15 @@ def init_state(config: StoreConfig = StoreConfig()) -> StoreState:
         cms_trace_spans=cms.init(c.cms_depth, c.cms_width).counts,
         ts_min=jnp.int64(I64_MAX),
         ts_max=jnp.int64(I64_MIN),
+        # Windowed Moments-sketch arena: integer cells (see the field
+        # comments above). min/max planes start at I32_MIN (the
+        # scatter-max empty sentinel — a zero fill would pin min_x at
+        # 0 because min rides max(-x)); consumers ignore them while
+        # the cell's duration count is 0.
+        win_epoch=_ring(c.win_slots, jnp.int64, -1),
+        win_counts=jnp.zeros((S, c.win_slots, 3), jnp.int32),
+        win_sums=jnp.zeros((S, c.win_slots, 4), jnp.int64),
+        win_mm=jnp.full((S, c.win_slots, 2), I32_MIN, jnp.int32),
         counters={
             "spans_seen": jnp.int64(0),
             "anns_seen": jnp.int64(0),
@@ -999,6 +1065,13 @@ class DeviceBatch(NamedTuple):
     bann_endpoint_id: jnp.ndarray
     n_banns: jnp.ndarray
 
+    # Per-span error flag ("error" annotation value / binary key),
+    # computed on the HOST in stage 1 (aggregate.windows
+    # span_error_flags — the dictionary lookup the device can't do) and
+    # consumed by the windowed-arena error counts. Defaults to all
+    # False for direct-device callers that don't track errors.
+    error_flag: jnp.ndarray
+
 
 def _pad(a: np.ndarray, n: int, fill=0, dtype=None) -> np.ndarray:
     dtype = dtype or a.dtype
@@ -1014,11 +1087,14 @@ def make_device_batch(
     pad_spans: int,
     pad_anns: int,
     pad_banns: int,
+    error_flag: np.ndarray = None,
 ) -> DeviceBatch:
     """Host: pad a SpanBatch (+ index columns) to static shapes.
 
     ``name_lc_id`` is the lowercased span-name dictionary id (-1 for empty
-    names); ``indexable`` is store.base.should_index computed per span.
+    names); ``indexable`` is store.base.should_index computed per span;
+    ``error_flag`` is the per-span error bit (windows.span_error_flags),
+    all-False when the caller doesn't track errors.
     """
     from zipkin_tpu.columnar.schema import FLAG_HAS_PARENT
 
@@ -1060,6 +1136,11 @@ def make_device_batch(
         bann_service_id=_pad(batch.bann_service_id, pad_banns, -1),
         bann_endpoint_id=_pad(batch.bann_endpoint_id, pad_banns, -1),
         n_banns=np.int32(batch.n_binary),
+        error_flag=_pad(
+            np.zeros(batch.n_spans, bool) if error_flag is None
+            else np.asarray(error_flag, bool),
+            pad_spans, False,
+        ),
     )
 
 
@@ -2439,6 +2520,60 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
         state.cms_trace_spans, cms_flat,
         jnp.ones(c.cms_depth * P, jnp.int32), c.use_pallas,
     )
+
+    # -- windowed Moments-sketch arena ---------------------------------
+    # (service × ring-indexed time bucket) integer cells; the host
+    # mirror folds the SAME rows in numpy (aggregate.windows
+    # apply_window_update) — every op here is an integer add/max so the
+    # two agree bitwise regardless of accumulation order. Budget: +5
+    # scatters (+1 of them the serialized i64 class, 4P rows), +2
+    # gathers, 0 sorts — the store/census.py r13 bump.
+    if c.window_enabled:
+        Wn = c.win_slots
+        w_ok = mask & (b.service_id >= 0) & (b.service_id < S) \
+            & (b.ts_first >= 0)
+        a_bkt = jnp.where(w_ok, b.ts_first, 0) // jnp.int64(c.window_us)
+        slot = (a_bkt % Wn).astype(jnp.int32)
+        slot = jnp.where(w_ok, slot, 0)
+        # Epoch war: each touched slot advances to the max absolute
+        # bucket offered this step; rows older than the winner (stale
+        # lates, or the losers of an in-batch ring wrap) are dropped.
+        new_epoch = _war_max64(state.win_epoch, slot, a_bkt, w_ok)
+        upd["win_epoch"] = new_epoch
+        stale = (new_epoch != state.win_epoch)[None, :, None]
+        counts_w = jnp.where(stale, jnp.int32(0), state.win_counts)
+        sums_w = jnp.where(stale, jnp.int64(0), state.win_sums)
+        mm_w = jnp.where(stale, I32_MIN, state.win_mm)
+        live = w_ok & (a_bkt == new_epoch[slot])
+        cid = g * Wn + slot  # g = clip(service_id) — valid where live
+        d_ok = live & (b.duration >= 0)
+        x = (bidx >> c.win_x_shift).astype(jnp.int32)
+        base3 = cid * 3
+        idx_c = jnp.concatenate([
+            jnp.where(live, base3, -1),
+            jnp.where(live & b.error_flag, base3 + 1, -1),
+            jnp.where(d_ok, base3 + 2, -1),
+        ])
+        upd["win_counts"] = _scatter_add(
+            counts_w, idx_c, jnp.ones(3 * P, jnp.int32), c.use_pallas
+        )
+        flat_s = sums_w.reshape(-1)
+        xi = x.astype(jnp.int64)
+        base4 = cid * 4
+        idx_s = jnp.concatenate([base4, base4 + 1, base4 + 2,
+                                 base4 + 3])
+        safe_s = jnp.where(jnp.tile(d_ok, 4), idx_s, flat_s.shape[0])
+        vals_s = jnp.concatenate([xi, xi * xi, xi * xi * xi,
+                                  xi * xi * xi * xi])
+        upd["win_sums"] = flat_s.at[safe_s].add(
+            vals_s, mode="drop").reshape(sums_w.shape)
+        flat_m = mm_w.reshape(-1)
+        base2 = cid * 2
+        idx_m = jnp.concatenate([base2, base2 + 1])
+        safe_m = jnp.where(jnp.tile(d_ok, 2), idx_m, flat_m.shape[0])
+        vals_m = jnp.concatenate([-x, x])
+        upd["win_mm"] = flat_m.at[safe_m].max(
+            vals_m, mode="drop").reshape(mm_w.shape)
 
     # -- time range + counters -----------------------------------------
     firsts = jnp.where(mask & (b.ts_first >= 0), b.ts_first, I64_MAX)

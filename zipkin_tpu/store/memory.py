@@ -90,6 +90,11 @@ class InMemorySpanStore(SpanStore):
         self._lock = threading.Lock()
         self.spans: List[Span] = []
         self.ttls: Dict[int, float] = {}
+        # Windowed-analytics time-bucket width (s) for the exact-scan
+        # heatmap — the daemon sets it from --window-seconds so a
+        # memory-store deployment serves the same grid granularity a
+        # device store would at the same flags.
+        self.window_seconds = 60
 
     # -- writes ---------------------------------------------------------
 
@@ -202,6 +207,133 @@ class InMemorySpanStore(SpanStore):
 
     def get_span_names(self, service: str) -> Set[str]:
         return {s.name for s in self._spans_for_service(service) if s.name}
+
+    # -- windowed analytics: the exact-scan oracle -----------------------
+    # Host-backend twins of the device store's windowed Moments-sketch
+    # reads (aggregate/windows.py), answered by scanning the raw span
+    # list: EXACT values with the SAME attribution rules — owning
+    # service (span.service_name, what the columnar encoder puts in
+    # service_id), first_timestamp for time bucketing, and the "error"
+    # annotation-value / binary-key convention. tests/test_windows.py
+    # uses these as the memory oracle the sketch answers are gated
+    # against; the API serves them for --memory-store parity.
+
+    @staticmethod
+    def _is_error_span(s: Span) -> bool:
+        return (any(a.value == "error" for a in s.annotations)
+                or any(b.key == "error" for b in s.binary_annotations))
+
+    def _windowed_spans(self, service: str, start_us, end_us):
+        service = service.lower()
+        with self._lock:
+            snapshot = list(self.spans)
+        out = []
+        for s in snapshot:
+            svc = s.service_name
+            ts = s.first_timestamp
+            if svc is None or svc.lower() != service or ts is None:
+                continue
+            if start_us is not None and ts < start_us:
+                continue
+            if end_us is not None and ts >= end_us:
+                continue
+            out.append(s)
+        return out
+
+    def windowed_quantiles(self, service: str, qs,
+                           start_us=None, end_us=None):
+        durs = sorted(
+            s.duration for s in self._windowed_spans(
+                service, start_us, end_us)
+            if s.duration is not None and s.duration >= 0)
+        if not durs:
+            return None
+        n = len(durs)
+        return [
+            float(durs[min(int(round(
+                min(max(q, 0.0), 1.0) * (n - 1))), n - 1)])
+            for q in qs
+        ]
+
+    def slo_burn(self, service: str, objective: float = None,
+                 windows_s=None, now_us=None):
+        from zipkin_tpu.aggregate import windows as win_mod
+
+        objective = (win_mod.DEFAULT_OBJECTIVE if objective is None
+                     else float(objective))
+        windows_s = list(windows_s or win_mod.DEFAULT_BURN_WINDOWS_S)
+        if now_us is None:
+            ts = [s.first_timestamp
+                  for s in self._windowed_spans(service, None, None)]
+            now_us = (max(ts) + 1) if ts else 0
+        budget = max(1.0 - objective, 1e-9)
+        out = []
+        for w_s in windows_s:
+            spans = self._windowed_spans(
+                service, int(now_us) - int(w_s) * 1_000_000,
+                int(now_us))
+            total = len(spans)
+            errors = sum(1 for s in spans if self._is_error_span(s))
+            rate = (errors / total) if total else 0.0
+            out.append({
+                "windowSeconds": int(w_s),
+                "total": total,
+                "errors": errors,
+                "errorRate": rate,
+                "burnRate": rate / budget,
+            })
+        return {"serviceName": service, "objective": objective,
+                "nowTs": int(now_us), "windows": out}
+
+    def latency_heatmap(self, service: str, start_us=None, end_us=None,
+                        bands: int = None, bucket_s: int = None):
+        """Exact grid: spans bucketed by first_timestamp // bucket_s
+        (default: the store's window_seconds), durations histogrammed
+        over ``bands`` log-spaced bands."""
+        import math
+
+        from zipkin_tpu.aggregate import windows as win_mod
+
+        bands = int(bands or win_mod.DEFAULT_HEATMAP_BANDS)
+        bucket_s = int(bucket_s or self.window_seconds or 60)
+        spans = self._windowed_spans(service, start_us, end_us)
+        bucket_us = int(bucket_s) * 1_000_000
+        by_bucket: Dict[int, list] = {}
+        for s in spans:
+            by_bucket.setdefault(s.first_timestamp // bucket_us,
+                                 []).append(s)
+        buckets = sorted(by_bucket)
+        durs = [s.duration for s in spans
+                if s.duration is not None and s.duration >= 0]
+        lo = math.log(max(min(durs), 1.0)) if durs else 0.0
+        hi = math.log(max(max(durs), 1.0) + 1.0) if durs else 1.0
+        if hi <= lo:
+            hi = lo + 1.0
+        edges = [math.exp(lo + (hi - lo) * i / bands)
+                 for i in range(bands + 1)]
+        grid = []
+        for b in buckets:
+            row = [0.0] * bands
+            for s in by_bucket[b]:
+                if s.duration is None or s.duration < 0:
+                    continue
+                v = max(float(s.duration), 1.0)
+                i = min(int((math.log(v) - lo) / (hi - lo) * bands),
+                        bands - 1)
+                row[max(i, 0)] += 1.0
+            grid.append(row)
+        return {
+            "serviceName": service,
+            "bucketSeconds": int(bucket_s),
+            "bucketStartsTs": [b * bucket_us for b in buckets],
+            "bandEdgesMicros": [round(e, 1) for e in edges],
+            "cells": grid,
+            "totals": [len(by_bucket[b]) for b in buckets],
+            "errors": [
+                sum(1 for s in by_bucket[b] if self._is_error_span(s))
+                for b in buckets
+            ],
+        }
 
 
 def _dedup_limit(matched: List[Span], limit: int) -> List[IndexedTraceId]:

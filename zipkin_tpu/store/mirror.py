@@ -36,10 +36,11 @@ exact by construction, since mirror state ≡ device state.
 from __future__ import annotations
 
 import threading
-from typing import NamedTuple, Sequence
+from typing import NamedTuple, Sequence, Tuple
 
 import numpy as np
 
+from zipkin_tpu.aggregate import windows as win
 from zipkin_tpu.models.constants import FIRST_USER_ANNOTATION_ID
 from zipkin_tpu.ops.hashing import split64
 from zipkin_tpu.store.archive.sketches import (
@@ -55,7 +56,9 @@ class SketchDelta(NamedTuple):
     """One launch unit's aggregate increments in COO form (flat indices
     into each mirror array; every index is pre-masked — invalid rows
     are already dropped, mirroring the device's ``where(ok, idx, -1)``
-    scatter convention)."""
+    scatter convention). ``win`` carries the windowed-arena rows
+    PER CHUNK (a chained unit runs one device step per chunk and the
+    epoch war is stateful, so chunks must fold in launch order)."""
 
     hist_idx: np.ndarray  # flat into svc_hist [S*B]
     svc_idx: np.ndarray  # into ann_svc_counts [S]
@@ -64,6 +67,7 @@ class SketchDelta(NamedTuple):
     bk_idx: np.ndarray  # flat into bann_key_counts [S*K]
     hll_idx: np.ndarray  # HLL register indices
     hll_rank: np.ndarray  # matching ranks (scatter-max)
+    win: Tuple[win.WindowUpdate, ...] = ()  # per-chunk window rows
 
 
 class SketchMirror:
@@ -71,7 +75,7 @@ class SketchMirror:
     docstring). Thread-safe: ``apply`` runs on the commit path,
     ``adopt`` on a resync, readers on API threads."""
 
-    def __init__(self, config):
+    def __init__(self, config, dicts=None):
         self.config = config
         c = config
         self.gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
@@ -85,6 +89,23 @@ class SketchMirror:
             (S, c.max_annotation_values), np.int32)
         self.bann_key_counts = np.zeros((S, c.max_binary_keys), np.int32)
         self.hll_traces = np.zeros(1 << c.hll_p, np.int32)
+        # Windowed Moments-sketch arena twins (aggregate/windows.py):
+        # same dtypes/fills as the device arrays, folded by the same
+        # integer adds/maxes → bitwise-equal cells. ``dicts`` resolves
+        # the "error" annotation/key ids for the per-span error bit
+        # (None = no dictionary ⇒ no error detection).
+        self.dicts = dicts
+        Wn = c.win_slots
+        self.win_epoch = np.full(Wn, -1, np.int64)
+        self.win_counts = np.zeros((S, Wn, win.N_COUNT_FIELDS), np.int32)
+        self.win_sums = np.zeros((S, Wn, win.N_SUM_FIELDS), np.int64)
+        self.win_mm = np.full((S, Wn, win.N_MM_FIELDS), win.I32_MIN,
+                              np.int32)
+        # Process-lifetime monotonic fold counters (the
+        # zipkin_window_* Prometheus families): unaffected by ring
+        # self-clears or adoption resyncs, so scrapes never regress.
+        self.win_spans_total = 0
+        self.win_errors_total = 0
 
     # -- state ----------------------------------------------------------
 
@@ -99,11 +120,15 @@ class SketchMirror:
             self._warm = False
 
     def adopt(self, svc_hist, ann_svc_counts, name_presence,
-              ann_value_counts, bann_key_counts, hll_traces) -> None:
+              ann_value_counts, bann_key_counts, hll_traces,
+              win_epoch=None, win_counts=None, win_sums=None,
+              win_mm=None) -> None:
         """Resync from already-fetched device arrays. Callers fetch
         under the store's READ lock (so no commit's delta can be
         concurrent with the snapshot) and adopt after — a delta from a
-        LATER commit applying after this simply lands on top."""
+        LATER commit applying after this simply lands on top. The
+        window arena rides the same snapshot (the lifetime fold
+        counters don't: they are process-monotonic by contract)."""
         with self._lock:
             self.svc_hist = np.array(svc_hist, np.int32)
             self.ann_svc_counts = np.array(ann_svc_counts, np.int32)
@@ -111,6 +136,11 @@ class SketchMirror:
             self.ann_value_counts = np.array(ann_value_counts, np.int32)
             self.bann_key_counts = np.array(bann_key_counts, np.int32)
             self.hll_traces = np.array(hll_traces, np.int32)
+            if win_epoch is not None:
+                self.win_epoch = np.array(win_epoch, np.int64)
+                self.win_counts = np.array(win_counts, np.int32)
+                self.win_sums = np.array(win_sums, np.int64)
+                self.win_mm = np.array(win_mm, np.int32)
             self._warm = True
 
     # -- write path ------------------------------------------------------
@@ -185,6 +215,23 @@ class SketchMirror:
             cat(av_parts), cat(bk_parts), cat(hll_i_parts),
             (np.concatenate(hll_r_parts) if hll_r_parts
              else np.zeros(0, np.int32)),
+            win=self._window_updates(group),
+        )
+
+    def _window_updates(self, group):
+        """Per-chunk windowed-arena rows — one WindowUpdate per launch
+        chunk, pre-masked exactly like the device step's w_ok (the
+        chained unit runs one step per chunk, and the epoch war is
+        stateful, so apply() folds them in order)."""
+        c = self.config
+        if not c.window_enabled:
+            return ()
+        ea, eb = (win.error_ids(self.dicts) if self.dicts is not None
+                  else (-1, -1))
+        return tuple(
+            win.plan_window_update(
+                batch, win.span_error_flags(batch, ea, eb), c)
+            for batch, _, _ in group
         )
 
     def apply(self, delta: SketchDelta) -> None:
@@ -204,6 +251,12 @@ class SketchMirror:
                       np.int32(1))
             np.maximum.at(self.hll_traces, delta.hll_idx,
                           delta.hll_rank)
+            for u in delta.win:
+                spans, errs = win.apply_window_update(
+                    u, self.win_epoch, self.win_counts,
+                    self.win_sums, self.win_mm)
+                self.win_spans_total += spans
+                self.win_errors_total += errs
 
     # -- reads (engine sketch tier) --------------------------------------
 
@@ -231,6 +284,27 @@ class SketchMirror:
         with self._lock:
             return self.hll_traces.copy()
 
+    def window_row(self, svc: int):
+        """(epoch, counts[svc], sums[svc], mm[svc]) copies — one
+        service's windowed cells for the analytics read path."""
+        with self._lock:
+            return (self.win_epoch.copy(), self.win_counts[svc].copy(),
+                    self.win_sums[svc].copy(), self.win_mm[svc].copy())
+
+    def window_arrays(self):
+        """Snapshot of the full window arena (bitwise gates + the
+        all-service heatmap)."""
+        with self._lock:
+            return (self.win_epoch.copy(), self.win_counts.copy(),
+                    self.win_sums.copy(), self.win_mm.copy())
+
+    def window_live_cells(self) -> int:
+        """Occupied (service, bucket) cells — the
+        zipkin_window_cells_active gauge."""
+        with self._lock:
+            return int(((self.win_counts[:, :, 0] > 0)
+                        & (self.win_epoch >= 0)[None, :]).sum())
+
     def arrays(self) -> Sequence[np.ndarray]:
         """Snapshot of every mirrored array (conformance tests compare
         these bitwise against the device state)."""
@@ -238,4 +312,6 @@ class SketchMirror:
             return (self.svc_hist.copy(), self.ann_svc_counts.copy(),
                     self.name_presence.copy(),
                     self.ann_value_counts.copy(),
-                    self.bann_key_counts.copy(), self.hll_traces.copy())
+                    self.bann_key_counts.copy(), self.hll_traces.copy(),
+                    self.win_epoch.copy(), self.win_counts.copy(),
+                    self.win_sums.copy(), self.win_mm.copy())

@@ -365,10 +365,14 @@ class TpuSpanStore(SpanStore):
         self._wal_applied = 0
         self._wal_marks = None
         # Host sketch mirror (store/mirror.SketchMirror): numpy twins
-        # of the device's lifetime aggregate arrays, updated by each
-        # commit's delta inside the write-lock hold — the query
-        # engine's zero-dispatch sketch tier (docs/QUERY_ENGINE.md).
-        self.sketch_mirror = SketchMirror(self.config)
+        # of the device's lifetime aggregate arrays AND the windowed
+        # Moments-sketch arena, updated by each commit's delta inside
+        # the write-lock hold — the query engine's zero-dispatch
+        # sketch tier (docs/QUERY_ENGINE.md). The dictionary set
+        # resolves the "error" convention ids for the window cells'
+        # error counts.
+        self.sketch_mirror = SketchMirror(self.config,
+                                          dicts=self.codec.dicts)
         # Read-visibility epoch: bumped by host-side state that changes
         # query answers WITHOUT a device commit (pin/TTL mutations,
         # pin-bank arrivals). write_frontier() = (_step_seq, epoch) is
@@ -431,6 +435,33 @@ class TpuSpanStore(SpanStore):
             "Compiled variants across the ingest/staging/capture jits "
             "(dev.compile_count; steady-state pipelined ingest adds 0)",
             fn=lambda: float(dev.compile_count())))
+        # Windowed Moments-sketch arena families (zipkin_window_*,
+        # docs/OBSERVABILITY.md): fold counters are process-monotonic
+        # mirror totals (never regress on ring self-clears or resync);
+        # the cell gauge reads live occupancy.
+        mirror = self.sketch_mirror
+        reg.register(obs.Counter(
+            "zipkin_window_spans_total",
+            "Spans folded into the windowed (service × time-bucket) "
+            "Moments-sketch cells since process start",
+            fn=lambda: float(mirror.win_spans_total)))
+        reg.register(obs.Counter(
+            "zipkin_window_errors_total",
+            "Error-flagged spans ('error' annotation value or binary "
+            "key) folded into the windowed cells since process start",
+            fn=lambda: float(mirror.win_errors_total)))
+        reg.register(obs.Gauge(
+            "zipkin_window_cells_active",
+            "Occupied (service, time-bucket) cells in the windowed "
+            "arena ring",
+            fn=lambda: float(mirror.window_live_cells())))
+        reg.register(obs.Gauge(
+            "zipkin_window_retention_seconds",
+            "Windowed-analytics retention: window_seconds × "
+            "window_buckets (0 = arena disabled)",
+            fn=lambda: float(
+                self.config.window_seconds * self.config.window_buckets
+                if self.config.window_enabled else 0.0)))
         # The zipkin_store_counter family is registered by ApiServer
         # from the generic counters() hook (one registration site for
         # every backend), not here.
@@ -841,8 +872,20 @@ class TpuSpanStore(SpanStore):
         the serial path). Chained groups pad every chunk to the group
         max and stack along a leading scan axis. pow2 bucketing bounds
         the jit compile cache, so a warmed steady state pads into
-        already-compiled shapes only (dev.compile_count gates this)."""
+        already-compiled shapes only (dev.compile_count gates this).
+
+        The per-span error bit (the window cells' error counts) is a
+        pure function of (batch, dictionary state) — WAL replay
+        rebuilds the dictionaries in append order, so a replayed unit
+        recomputes identical flags (aggregate.windows)."""
+        from zipkin_tpu.aggregate import windows as win_mod
+
         sketch = self.sketch_mirror.delta_of(group)
+        if self.config.window_enabled:
+            ea, eb = win_mod.error_ids(self.dicts)
+            err_of = lambda b: win_mod.span_error_flags(b, ea, eb)  # noqa: E731
+        else:
+            err_of = lambda b: None  # noqa: E731 — flag lowers out
         if len(group) == 1:
             b, lc, ix = group[0]
             db = dev.make_device_batch(
@@ -850,6 +893,7 @@ class TpuSpanStore(SpanStore):
                 pad_spans=_next_pow2(b.n_spans),
                 pad_anns=_next_pow2(b.n_annotations),
                 pad_banns=_next_pow2(b.n_binary),
+                error_flag=err_of(b),
             )
             return IngestUnit(db, b.n_spans, b.n_annotations,
                               b.n_binary, 1, False, sketch=sketch)
@@ -860,6 +904,7 @@ class TpuSpanStore(SpanStore):
             dev.make_device_batch(
                 b, name_lc_id=lc, indexable=ix,
                 pad_spans=pad_s, pad_anns=pad_a, pad_banns=pad_b,
+                error_flag=err_of(b),
             )
             for b, lc, ix in group
         ]
@@ -1313,10 +1358,147 @@ class TpuSpanStore(SpanStore):
                 host = jax.device_get((
                     st.svc_hist, st.ann_svc_counts, st.name_presence,
                     st.ann_value_counts, st.bann_key_counts,
-                    st.hll_traces,
+                    st.hll_traces, st.win_epoch, st.win_counts,
+                    st.win_sums, st.win_mm,
                 ))
                 m.adopt(*host)
         return m
+
+    # -- windowed analytics (aggregate/windows.py) ----------------------
+    # Every read below is HOST-ONLY: the mirror twins of the device's
+    # windowed Moments-sketch arena answer with zero device
+    # round-trips (the PR 6 sub-10ms sketch tier). Window answers are
+    # whole-bucket granular: [start_us, end_us) expands to the time
+    # buckets it overlaps, and only buckets still live in the ring
+    # (window_seconds × window_buckets of retention) contribute.
+
+    def _window_ctx(self, service: str):
+        """(mirror, svc id) — or (None, None) when the arena can't
+        represent the service (disabled arena, unknown name, or a
+        dictionary-overflow id past max_services)."""
+        c = self.config
+        if not c.window_enabled:
+            return None, None
+        svc = self._svc_id(service)
+        if svc is None or svc >= c.max_services:
+            return None, None
+        return self.ensure_sketch_mirror(), svc
+
+    def _bucket_range(self, epoch, start_us, end_us):
+        """[b0, b1] absolute-bucket span for a µs half-open window;
+        None bounds default to the arena's live extent."""
+        bucket_us = self.config.window_us
+        live = epoch[epoch >= 0]
+        if start_us is None:
+            b0 = int(live.min()) if live.size else 0
+        else:
+            b0 = max(0, int(start_us) // bucket_us)
+        if end_us is None:
+            b1 = int(live.max()) if live.size else -1
+        else:
+            b1 = (max(0, int(end_us)) - 1) // bucket_us
+        return b0, b1
+
+    def windowed_quantiles(self, service: str, qs,
+                           start_us=None, end_us=None):
+        """Duration quantile estimates (µs) for ``service`` over the
+        time window — a cell-sum + one Moments solve
+        (windows.quantiles_from_sums; tolerance documented there).
+        None when no duration-carrying span is in the window."""
+        from zipkin_tpu.aggregate import windows as win_mod
+
+        m, svc = self._window_ctx(service)
+        if m is None:
+            return None
+        epoch, counts, sums, mm = m.window_row(svc)
+        b0, b1 = self._bucket_range(epoch, start_us, end_us)
+        ws = win_mod.merge_cells(epoch, counts, sums, mm, b0, b1)
+        return win_mod.quantiles_from_sums(
+            ws, list(qs), m.gamma, self.config.win_x_shift)
+
+    def slo_burn(self, service: str, objective: float = None,
+                 windows_s=None, now_us=None):
+        """Multi-window error-budget burn rates: per lookback window,
+        error rate over the covered cells divided by the budget
+        (1 - objective). ``now_us`` defaults to the end of the arena's
+        newest live bucket (data time, so replays and tests are
+        deterministic). None when the arena can't serve the service."""
+        from zipkin_tpu.aggregate import windows as win_mod
+
+        objective = (win_mod.DEFAULT_OBJECTIVE if objective is None
+                     else float(objective))
+        windows_s = list(windows_s or win_mod.DEFAULT_BURN_WINDOWS_S)
+        m, svc = self._window_ctx(service)
+        if m is None:
+            return None
+        epoch, counts, sums, mm = m.window_row(svc)
+        bucket_us = self.config.window_us
+        live = epoch[epoch >= 0]
+        if now_us is None:
+            now_us = (int(live.max()) + 1) * bucket_us if live.size else 0
+        budget = max(1.0 - objective, 1e-9)
+        out = []
+        for w_s in windows_s:
+            b1 = (int(now_us) - 1) // bucket_us
+            b0 = max(0, (int(now_us) - int(w_s) * 1_000_000)
+                     // bucket_us)
+            ws = win_mod.merge_cells(epoch, counts, sums, mm, b0, b1)
+            rate = ws.error_rate
+            out.append({
+                "windowSeconds": int(w_s),
+                "total": ws.total,
+                "errors": ws.err,
+                "errorRate": rate,
+                "burnRate": rate / budget,
+            })
+        return {"serviceName": service, "objective": objective,
+                "nowTs": int(now_us), "windows": out}
+
+    def latency_heatmap(self, service: str, start_us=None, end_us=None,
+                        bands: int = None):
+        """Service × time × duration-bucket grid: one column per live
+        time bucket in range, ``bands`` log-spaced duration bands,
+        cell mass from each column's Moments solve. None when the
+        arena can't serve the service."""
+        from zipkin_tpu.aggregate import windows as win_mod
+
+        bands = int(bands or win_mod.DEFAULT_HEATMAP_BANDS)
+        m, svc = self._window_ctx(service)
+        if m is None:
+            return None
+        epoch, counts, sums, mm = m.window_row(svc)
+        b0, b1 = self._bucket_range(epoch, start_us, end_us)
+        slots = win_mod.live_slots(epoch, b0, b1)
+        order = np.argsort(epoch[slots])
+        slots = slots[order]
+        cells = win_mod.cell_sums(slots, counts, sums, mm)
+        bucket_us = self.config.window_us
+        shift = self.config.win_x_shift
+        with_dur = [c for c in cells if c.n > 0]
+        if with_dur:
+            lo = min(c.min_x for c in with_dur)
+            hi = max(c.max_x for c in with_dur)
+        else:
+            lo = hi = 0
+        edges = win_mod.band_edges_x(lo, hi, bands)
+        grid = [
+            [round(v, 3) for v in win_mod.band_masses(c, edges)]
+            for c in cells
+        ]
+        return {
+            "serviceName": service,
+            "bucketSeconds": self.config.window_seconds,
+            "bucketStartsTs": [int(epoch[w]) * bucket_us
+                               for w in slots],
+            "bandEdgesMicros": [
+                round(win_mod.x_edge_duration(int(e), m.gamma, shift),
+                      1)
+                for e in edges
+            ],
+            "cells": grid,
+            "totals": [c.total for c in cells],
+            "errors": [c.err for c in cells],
+        }
 
     # -- id lookups -----------------------------------------------------
 
@@ -1882,6 +2064,11 @@ class TpuSpanStore(SpanStore):
         out["scatter_path_pallas"] = float(
             "pallas" in paths.get("scatter", ()))
         out["batch_spans_limit"] = float(self._max_chunk_spans())
+        # Windowed-arena fold accounting (host-monotonic mirror
+        # counters — zero device traffic, like every read above).
+        out["window_spans"] = float(self.sketch_mirror.win_spans_total)
+        out["window_errors"] = float(
+            self.sketch_mirror.win_errors_total)
         return out
 
     def stored_span_count(self) -> float:
