@@ -818,6 +818,40 @@ def run_windows() -> dict:
     }
 
 
+def run_lint() -> dict:
+    """graftlint phase (tier-1 gated): the concurrency/JAX-hazard
+    analyzer (zipkin_tpu/analysis, docs/STATIC_ANALYSIS.md) over the
+    whole package against the checked-in baseline. Zero NEW findings
+    is the gate — the lock-order/guarded-by/sync-under-lock/jit
+    conventions the write path depends on stay machine-checked on
+    every CI run, inside the analyzer's 30s budget."""
+    import os
+
+    from zipkin_tpu.analysis import ALL_RULES, analyze, load_project
+    from zipkin_tpu.analysis import baseline as lint_baseline
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = time.perf_counter()
+    project = load_project([os.path.join(repo, "zipkin_tpu")], repo)
+    findings = analyze(project)
+    base_path = os.path.join(repo, "graftlint-baseline.json")
+    if os.path.exists(base_path):
+        new, stale = lint_baseline.diff(
+            findings, lint_baseline.load(base_path))
+    else:
+        new, stale = findings, []
+    return {
+        "files": len(project.modules),
+        "locks": len(project.locks),
+        "rules": len(ALL_RULES),
+        "findings_total": len(findings),
+        "findings_new": len(new),
+        "stale_baseline_entries": len(stale),
+        "new": [f.render() for f in new[:20]],
+        "elapsed_s": round(time.perf_counter() - t0, 2),
+    }
+
+
 def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
     import numpy as np  # noqa: F401  (kept: smoke envs import-check it)
 
@@ -933,6 +967,7 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         "query": run_query(),
         "ingest_structure": run_ingest_structure(),
         "windows": run_windows(),
+        "lint": run_lint(),
         # The main stream runs the library default (window arena OFF),
         # so its step census gates at the BASE ceilings; the windows
         # phase gates the window-on lowering at BASE + WINDOW_BUMP.

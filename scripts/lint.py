@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""graftlint entry point — the repo's concurrency/JAX-hazard analyzer.
+
+    python scripts/lint.py                  # gate against the baseline
+    python scripts/lint.py --write-baseline # accept current findings
+    python scripts/lint.py --fix-annotations
+    python scripts/lint.py --list-rules
+
+See docs/STATIC_ANALYSIS.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from zipkin_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""FP twin: call sites hold the lock (directly or transitively)."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-order: 10 store
+        self.n = 0
+
+    def _bump_locked(self):  # called-under: _lock
+        self.n += 1
+
+    def good(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _chain_locked(self):  # called-under: _lock
+        self._bump_locked()

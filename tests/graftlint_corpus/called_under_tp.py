@@ -1,0 +1,14 @@
+"""TP: a called-under method invoked without the declared lock."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-order: 10 store
+        self.n = 0
+
+    def _bump_locked(self):  # called-under: _lock
+        self.n += 1
+
+    def bad(self):
+        self._bump_locked()  # lock not held

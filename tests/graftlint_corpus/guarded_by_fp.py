@@ -1,0 +1,40 @@
+"""FP twin: every access holds the lock (directly, via called-under,
+or in __init__); rwlock mode semantics; sanctioned foreign access."""
+import threading
+
+
+class RWLock:
+    pass
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-order: 10 store
+        self._rw = RWLock()  # lock-order: 40 commit
+        self._frontier = 0  # guarded-by: _lock
+        self.state = object()  # guarded-by: _rw.write
+        self._frontier = 1  # __init__ is exempt
+
+    def bump(self):
+        with self._lock:
+            self._frontier += 1
+        self._locked_peek()
+
+    def _locked_peek(self):  # called-under: _lock
+        return self._frontier
+
+    def swap(self, new):
+        with self._rw.write():
+            self.state = new
+
+    def read(self):
+        with self._rw.read():
+            return self.state
+
+    def suppressed(self):
+        return self._frontier  # graftlint: disable=guarded-by
+
+
+def foreign(store):
+    with store._lock:
+        return store._frontier

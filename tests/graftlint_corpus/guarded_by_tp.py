@@ -1,0 +1,19 @@
+"""TP: guarded attribute read+written outside its lock; also a
+foreign private access without the owner lock."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()  # lock-order: 10 store
+        self._frontier = 0  # guarded-by: _lock
+
+    def bump(self):
+        self._frontier += 1  # no lock held
+
+    def peek(self):
+        return self._frontier  # no lock held
+
+
+def foreign(store):
+    return store._frontier  # not inside 'with store._lock'

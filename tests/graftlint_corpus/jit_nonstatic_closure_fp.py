@@ -1,0 +1,10 @@
+"""FP twin: UPPERCASE constants and locals are fine."""
+import jax
+
+SCALE = 3
+
+
+@jax.jit
+def step(x):
+    local = 2
+    return x * SCALE + local

@@ -1,0 +1,12 @@
+"""TP: jitted fn closes over a lowercase module scalar and a
+reassigned module global."""
+import jax
+
+scale = 3
+MODE = 1
+MODE = 2
+
+
+@jax.jit
+def step(x):
+    return x * scale + MODE

@@ -1,0 +1,23 @@
+"""FP twin: branches on static params and on Noneness only."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(1,))
+def step(x, k, mask=None):
+    if k > 2:
+        x = x * 2
+    if mask is not None:
+        x = jnp.where(mask, x, 0)
+
+    def body(x, n):
+        # Nested def: its own (shadowing) params run in a different
+        # trace scope — branching here must not read as a branch on
+        # the OUTER traced x.
+        if n > 0:
+            return x
+        return -x
+
+    return body(x, 3)

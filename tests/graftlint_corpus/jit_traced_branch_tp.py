@@ -1,0 +1,11 @@
+"""TP: Python branch on a traced parameter inside a jitted fn."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnums=(1,))
+def step(x, k):
+    if x > 0:
+        return x + k
+    return x - k

@@ -1,0 +1,23 @@
+"""FP twin: a -> b only (a DAG), and RLock re-entry is legal."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self.a = threading.Lock()  # lock-order: 10 a
+        self.b = threading.Lock()  # lock-order: 20 b
+        self.r = threading.RLock()  # lock-order: 30 r
+
+    def path_one(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def path_two(self):
+        with self.a:
+            pass
+
+    def reenter_rlock(self):
+        with self.r:
+            with self.r:
+                pass

@@ -1,0 +1,24 @@
+"""TP: a <-> b acquisition cycle across two call paths, plus a
+non-reentrant self re-entry."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self.a = threading.Lock()  # lock-order: 10 a
+        self.b = threading.Lock()  # lock-order: 20 b
+
+    def path_one(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def path_two(self):
+        with self.b:
+            with self.a:
+                pass
+
+    def reenter(self):
+        with self.a:
+            with self.a:
+                pass

@@ -1,0 +1,16 @@
+"""FP twin: strictly increasing ranks, incl. through a callee."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self.a = threading.Lock()  # lock-order: 10 outer
+        self.b = threading.Lock()  # lock-order: 20 inner
+
+    def good(self):
+        with self.a:
+            self._inner()
+
+    def _inner(self):
+        with self.b:
+            pass

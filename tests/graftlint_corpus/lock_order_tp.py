@@ -1,0 +1,13 @@
+"""TP: acquires the outer-ranked lock while holding the inner one."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self.a = threading.Lock()  # lock-order: 10 outer
+        self.b = threading.Lock()  # lock-order: 20 inner
+
+    def bad(self):
+        with self.b:
+            with self.a:  # rank 10 acquired under rank 20
+                pass

@@ -1,0 +1,46 @@
+"""FP twin: re-raise, use the bound error, or count it."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class C:
+    def inc(self):
+        pass
+
+
+errors = C()
+
+
+def risky():
+    raise ValueError("boom")
+
+
+def reraises():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def parks():
+    parked = None
+    try:
+        risky()
+    except Exception as e:
+        parked = e
+    return parked
+
+
+def logs():
+    try:
+        risky()
+    except Exception:
+        log.exception("risky failed")
+
+
+def counts():
+    try:
+        risky()
+    except Exception:
+        errors.inc()

@@ -1,0 +1,19 @@
+"""TP: broad except that drops the error on the floor."""
+
+
+def risky():
+    raise ValueError("boom")
+
+
+def bad():
+    try:
+        risky()
+    except Exception:
+        pass
+
+
+def bad_bare():
+    try:
+        risky()
+    except:  # noqa: E722
+        return None

@@ -1,0 +1,23 @@
+"""FP twin: syncs under the READ lock (the sanctioned capture-pull
+pattern) or outside locks entirely."""
+import jax
+import numpy as np
+
+
+class RWLock:
+    pass
+
+
+class Store:
+    def __init__(self):
+        self._rw = RWLock()  # lock-order: 40 commit
+        self.state = None
+
+    def good_read(self, x):
+        with self._rw.read():
+            return jax.device_get(x)
+
+    def good_unlocked(self, x):
+        got = np.asarray(x)
+        with self._rw.write():
+            self.state = got

@@ -1,0 +1,25 @@
+"""TP: device syncs inside a write-lock region — lexically and
+through a resolvable callee."""
+import jax
+import numpy as np
+
+
+class RWLock:
+    pass
+
+
+class Store:
+    def __init__(self):
+        self._rw = RWLock()  # lock-order: 40 commit
+        self.state = None
+
+    def bad_direct(self, x):
+        with self._rw.write():
+            return jax.device_get(x)
+
+    def bad_via_call(self, x):
+        with self._rw.write():
+            return self._pull(x)
+
+    def _pull(self, x):
+        return np.asarray(x)

@@ -1,0 +1,9 @@
+"""FP twin: annotated lock (module-level too)."""
+import threading
+
+_MOD_LOCK = threading.Lock()  # lock-order: 20 module
+
+
+class Store:
+    def __init__(self):
+        self.dressed = threading.Lock()  # lock-order: 10 dressed

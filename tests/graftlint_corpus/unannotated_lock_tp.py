@@ -1,0 +1,7 @@
+"""TP: a lock with no lock-order annotation."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self.naked = threading.Lock()

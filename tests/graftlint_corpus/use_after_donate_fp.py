@@ -1,0 +1,31 @@
+"""FP twin: the canonical rebind loop, and rebinding before reuse."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def drive(state, batches):
+    for batch in batches:
+        state = step(state, batch)
+    return state
+
+
+def rebound(state, batch):
+    state = step(state, batch)
+    return state.sum()
+
+
+def nested_scope(state, batch):
+    out = step(state, batch)
+
+    def later(state):
+        # Different scope + own param: not a read of the donated
+        # outer buffer.
+        return state + 1
+
+    return later(out)

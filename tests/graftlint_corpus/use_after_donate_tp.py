@@ -1,0 +1,14 @@
+"""TP: donated buffer read after the donating call."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def drive(state, batch):
+    out = step(state, batch)
+    return out + state.sum()  # state's buffer was donated

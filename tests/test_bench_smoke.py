@@ -186,3 +186,13 @@ def test_bench_smoke_json_and_op_ceilings():
     assert w["burn_errors"] >= 1, w
     assert w["heatmap_columns"] >= 1, w
     assert w["window_spans_folded"] > 0, w
+    # graftlint phase (this PR's tentpole): the concurrency/JAX-hazard
+    # analyzer must cover the whole package, find ZERO findings not in
+    # the checked-in baseline, and stay inside its 30s budget (the
+    # fixture-corpus sensitivity pins live in tests/test_analysis.py;
+    # this gates the smoke wiring end-to-end).
+    lint = rec["lint"]
+    assert lint["findings_new"] == 0, lint
+    assert lint["files"] >= 80, lint
+    assert lint["locks"] >= 25, lint
+    assert lint["elapsed_s"] < 30.0, lint

@@ -13,6 +13,7 @@ from zipkin_tpu.parallel.shard import (
     ShardedStore,
     global_summary,
     stack_batches,
+    stacked_incoming,
 )
 from zipkin_tpu.store import device as dev
 from zipkin_tpu.store.tpu import TpuSpanStore
@@ -54,7 +55,8 @@ def test_sharded_ingest_totals(mesh):
     store = ShardedStore(mesh, CFG)
     helper = TpuSpanStore(CFG)
     gen = ColumnarTraceGen(helper.dicts, n_services=8, n_span_names=16)
-    summary = store.ingest(_shard_batches(mesh, gen))
+    stacked = _shard_batches(mesh, gen)
+    summary = store.ingest(stacked, incoming=stacked_incoming(stacked))
     assert float(summary["spans_seen"]) == n * 4 * 7
     # Additive sketches: total span count per service sums across shards.
     assert float(np.asarray(summary["svc_span_counts"]).sum()) == n * 4 * 7
@@ -65,7 +67,8 @@ def test_sharded_hll_is_union(mesh):
     store = ShardedStore(mesh, CFG)
     helper = TpuSpanStore(CFG)
     gen = ColumnarTraceGen(helper.dicts, n_services=8, n_span_names=16)
-    summary = store.ingest(_shard_batches(mesh, gen, traces_per_shard=8))
+    stacked = _shard_batches(mesh, gen, traces_per_shard=8)
+    summary = store.ingest(stacked, incoming=stacked_incoming(stacked))
     est = float(hll.estimate(hll.HyperLogLog(summary["hll_traces"])))
     true = n * 8  # all trace ids distinct across shards
     assert abs(est - true) / true < 0.25
@@ -92,7 +95,7 @@ def test_sharded_dep_moments_match_single_store(mesh):
     ]
     stacked = jax.device_put(stack_batches(dbs),
                              NamedSharding(mesh, P("shard")))
-    summary = sharded.ingest(stacked)
+    summary = sharded.ingest(stacked, incoming=stacked_incoming(stacked))
 
     got = np.asarray(summary["dep_moments"], np.float64)
     want = np.asarray(dev.total_dep_moments(single.state), np.float64)
@@ -149,7 +152,9 @@ def test_sharded_dep_links_survive_eviction(mesh):
     rounds = 25  # 28 spans/shard/round vs capacity 256: wraps ~3x
     last_total = 0.0
     for _ in range(rounds):
-        summary = store.ingest(_shard_batches(mesh, gen))
+        stacked = _shard_batches(mesh, gen)
+        summary = store.ingest(stacked,
+                               incoming=stacked_incoming(stacked))
         total = float(np.asarray(summary["dep_moments"])[:, 0].sum())
         assert total >= last_total  # link counts never regress
         last_total = total
@@ -166,7 +171,8 @@ def test_summary_dep_compaction_parity(mesh):
     store = ShardedStore(mesh, CFG)
     helper = TpuSpanStore(CFG)
     gen = ColumnarTraceGen(helper.dicts, n_services=8, n_span_names=16)
-    store.ingest(_shard_batches(mesh, gen))
+    stacked = _shard_batches(mesh, gen)
+    store.ingest(stacked, incoming=stacked_incoming(stacked))
     full = global_summary(store.states, mesh, dep_k=None)
     want = np.asarray(full["dep_moments"])
     # Branch preconditions, asserted so geometry drift can't silently

@@ -1,0 +1,30 @@
+"""graftlint: the project's AST-based concurrency & JAX-hazard
+analyzer (docs/STATIC_ANALYSIS.md).
+
+The five-thread write machine built across PRs 4-8 (pipeline stages,
+EvictionSealer, WAL group commit, ResidentCoalescer, mirror folds
+under the commit write lock) rests on conventions — canonical lock
+order, guarded-by ownership of shared attributes, no device sync under
+the write lock, zero steady-state recompiles, donated buffers never
+reused. graftlint turns each convention into a named, suppressible,
+baselined rule so the next concurrency layer (sharding, replication,
+multi-tenant) grows against a machine-checked contract instead of
+whichever test happens to trip first.
+
+Entry points: ``scripts/lint.py`` (CLI), :func:`analyze` +
+:func:`load_project` (library), the fixture corpus under
+``tests/graftlint_corpus/`` (per-rule true/false-positive pins).
+"""
+
+from zipkin_tpu.analysis.cli import analyze, main
+from zipkin_tpu.analysis.model import ALL_RULES, Finding
+from zipkin_tpu.analysis.project import Project, load_project
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "Project",
+    "analyze",
+    "load_project",
+    "main",
+]

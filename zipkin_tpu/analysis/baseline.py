@@ -1,0 +1,71 @@
+"""graftlint baseline: accepted pre-existing findings, checked in as
+JSON so the tier-1 gate fails only on NEW violations.
+
+Fingerprints are line-number-free (rule + path + scope + detail), so
+unrelated edits don't churn the file; counts allow N accepted
+instances of the same fingerprint. ``--write-baseline`` regenerates it
+(review the diff like any other code change — a GROWING baseline is a
+new violation being grandfathered).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from zipkin_tpu.analysis.model import Finding
+
+VERSION = 1
+
+
+def to_baseline(findings: List[Finding]) -> dict:
+    per_rule: Dict[str, Counter] = {}
+    for f in findings:
+        per_rule.setdefault(f.rule, Counter())[f.fingerprint] += 1
+    return {
+        "version": VERSION,
+        "findings": {
+            rule: dict(sorted(per_rule[rule].items()))
+            for rule in sorted(per_rule)
+        },
+    }
+
+
+def save(path: str, findings: List[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(to_baseline(findings), fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def load(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != VERSION:
+        raise ValueError(
+            f"unsupported graftlint baseline version "
+            f"{data.get('version')!r} in {path}")
+    return data
+
+
+def diff(findings: List[Finding],
+         baseline: dict) -> Tuple[List[Finding], List[str]]:
+    """(new findings not covered by the baseline, stale baseline
+    fingerprints that no longer occur)."""
+    accepted: Dict[Tuple[str, str], int] = {}
+    for rule, fps in baseline.get("findings", {}).items():
+        for fp, n in fps.items():
+            accepted[(rule, fp)] = int(n)
+    used: Counter = Counter()
+    new: List[Finding] = []
+    for f in sorted(findings, key=lambda x: (x.path, x.line)):
+        key = (f.rule, f.fingerprint)
+        if used[key] < accepted.get(key, 0):
+            used[key] += 1
+        else:
+            new.append(f)
+    stale = sorted(
+        f"{rule}:{fp}" for (rule, fp), n in accepted.items()
+        if used[(rule, fp)] < n
+    )
+    return new, stale

@@ -1,0 +1,222 @@
+"""graftlint CLI (the engine behind ``scripts/lint.py``).
+
+Usage:
+    python scripts/lint.py [paths...] [--baseline FILE]
+        [--write-baseline] [--fix-annotations] [--rules r1,r2]
+        [--format text|json] [--list-rules] [--with-pyflakes]
+
+Exit status: 0 when every finding is covered by the baseline (or there
+are none), 1 when NEW findings exist, 2 on usage errors. See
+docs/STATIC_ANALYSIS.md for the rule catalog and workflows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from zipkin_tpu.analysis import baseline as baseline_mod
+from zipkin_tpu.analysis.model import ALL_RULES, Finding
+from zipkin_tpu.analysis.project import Project, load_project
+from zipkin_tpu.analysis.rules_guard import (
+    apply_annotations,
+    check_guarded_by,
+    suggest_annotations,
+)
+from zipkin_tpu.analysis.rules_jax import (
+    check_jit_rules,
+    check_use_after_donate,
+)
+from zipkin_tpu.analysis.rules_locks import (
+    check_called_under,
+    check_lock_cycles,
+    check_lock_order,
+    check_sync_under_lock,
+    check_unannotated,
+)
+from zipkin_tpu.analysis.rules_misc import check_swallowed
+
+DEFAULT_BASELINE = "graftlint-baseline.json"
+
+_CHECKS = (
+    check_lock_order,
+    check_lock_cycles,
+    check_unannotated,
+    check_guarded_by,
+    check_called_under,
+    check_sync_under_lock,
+    check_jit_rules,
+    check_use_after_donate,
+    check_swallowed,
+)
+
+
+def analyze(project: Project,
+            rules: Optional[List[str]] = None) -> List[Finding]:
+    """All findings for ``project``, suppressions applied, sorted."""
+    findings: List[Finding] = []
+    for check in _CHECKS:
+        findings.extend(check(project))
+    if rules:
+        findings = [f for f in findings if f.rule in rules]
+    return sorted(_apply_suppressions(project, findings),
+                  key=lambda f: (f.path, f.line, f.rule, f.detail))
+
+
+def _apply_suppressions(project: Project,
+                        findings: List[Finding]) -> List[Finding]:
+    mods = {m.path: m for m in project.modules}
+    func_suppress: Dict[str, Dict[str, tuple]] = {}
+    for m in project.modules:
+        func_suppress[m.path] = {
+            f.qualname: f.suppressed for f in m.all_funcs()
+        }
+    out = []
+    for f in findings:
+        m = mods.get(f.path)
+        if m is not None:
+            if f.rule in m.file_suppressed:
+                continue
+            from zipkin_tpu.analysis.model import parse_disables
+
+            line_dis = parse_disables(m.comments.get(f.line, ""))
+            if f.rule in line_dis:
+                continue
+            if f.rule in func_suppress[f.path].get(f.scope, ()):
+                continue
+        out.append(f)
+    return out
+
+
+def run_external_linters(repo_root: str, paths: List[str]) -> int:
+    """Optional ruff/pyflakes pass (generic pyflakes-class checks stay
+    out of graftlint, which carries only project-specific rules). Both
+    are soft dependencies: absent tools are skipped, not failures —
+    the container does not bake them in."""
+    rc = 0
+    for tool in (("ruff", "check"), ("pyflakes",)):
+        probe = subprocess.run(
+            [sys.executable, "-m", tool[0], "--version"],
+            capture_output=True, cwd=repo_root)
+        if probe.returncode != 0:
+            print(f"graftlint: {tool[0]} not installed; skipping",
+                  file=sys.stderr)
+            continue
+        got = subprocess.run(
+            [sys.executable, "-m", *tool, *paths], cwd=repo_root)
+        rc = rc or got.returncode
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="zipkin-tpu concurrency/JAX-hazard analyzer")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: zipkin_tpu)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline JSON (default: {DEFAULT_BASELINE} "
+                         "at the repo root when present)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from current "
+                         "findings and exit 0")
+    ap.add_argument("--fix-annotations", action="store_true",
+                    help="insert '# guarded-by:' annotations for "
+                         "attributes consistently accessed under "
+                         "exactly one lock")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--with-external", action="store_true",
+                    help="also run ruff/pyflakes when installed")
+    ap.add_argument("--repo-root", default=None)
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(r)
+        return 0
+
+    # cli.py lives at zipkin_tpu/analysis/cli.py: the repo root is two
+    # levels up; --repo-root overrides for odd layouts.
+    repo_root = os.path.abspath(
+        args.repo_root if args.repo_root else os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+    paths = args.paths or [os.path.join(repo_root, "zipkin_tpu")]
+    paths = [p if os.path.isabs(p) else os.path.join(repo_root, p)
+             for p in paths]
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    if rules:
+        unknown = [r for r in rules if r not in ALL_RULES]
+        if unknown:
+            print(f"graftlint: unknown rules {unknown}; see "
+                  "--list-rules", file=sys.stderr)
+            return 2
+
+    t0 = time.perf_counter()
+    project = load_project(paths, repo_root)
+
+    if args.fix_annotations:
+        proposals = suggest_annotations(project)
+        edits = apply_annotations(repo_root, proposals)
+        for e in edits:
+            print(e)
+        print(f"graftlint: annotated {len(edits)} attribute(s)")
+        return 0
+
+    findings = analyze(project, rules)
+
+    baseline_path = args.baseline or os.path.join(
+        repo_root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        baseline_mod.save(baseline_path, findings)
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(baseline_path, repo_root)}")
+        return 0
+
+    if os.path.exists(baseline_path):
+        base = baseline_mod.load(baseline_path)
+        new, stale = baseline_mod.diff(findings, base)
+    else:
+        new, stale = findings, []
+
+    elapsed = time.perf_counter() - t0
+    if args.format == "json":
+        print(json.dumps({
+            "metric": "graftlint",
+            "files": len(project.modules),
+            "findings_total": len(findings),
+            "findings_new": len(new),
+            "stale_baseline_entries": len(stale),
+            "elapsed_s": round(elapsed, 3),
+            "new": [f.__dict__ for f in new],
+        }))
+    else:
+        for f in new:
+            print(f.render())
+        for s in stale:
+            print(f"note: stale baseline entry (no longer occurs): {s}",
+                  file=sys.stderr)
+        print(f"graftlint: {len(project.modules)} files, "
+              f"{len(findings)} finding(s), {len(new)} new, "
+              f"{len(stale)} stale baseline entr(ies) "
+              f"in {elapsed:.2f}s", file=sys.stderr)
+
+    rc = 1 if new else 0
+    if args.with_external:
+        rc = rc or run_external_linters(
+            repo_root, [os.path.relpath(p, repo_root) for p in paths])
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

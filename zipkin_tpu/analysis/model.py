@@ -1,0 +1,288 @@
+"""graftlint data model: findings, annotations, and the per-module
+facts the AST visitor extracts.
+
+The analyzer enforces the concurrency/JAX conventions the write path
+has accumulated since PR 4 (lock ordering, mirror-fold-under-write-
+lock, no device sync while holding the commit write lock, zero
+steady-state recompiles) as named, suppressible rules — see
+docs/STATIC_ANALYSIS.md for the catalog. Everything here is plain
+dataclasses; the visitor (visitor.py) fills them, the project loader
+(project.py) links them across modules, and the rule modules
+(rules_*.py) read them.
+
+Annotation conventions (comments the visitor parses):
+
+- ``# lock-order: <rank> [prose]`` on a lock's creation line — declares
+  the lock's position in the canonical acquisition order (lower rank =
+  acquired first / outermost).
+- ``# guarded-by: <lockattr>`` on a shared attribute's ``__init__``
+  assignment — every non-init access of the attribute must hold that
+  lock. For RWLock-guarded attributes, ``# guarded-by: <attr>.write``
+  requires the write lock for stores and either mode for loads.
+- ``# called-under: <lockattr>[.read|.write]`` on a ``def`` line — the
+  method runs with that lock already held; resolvable call sites are
+  checked for it, and the body is analyzed as if holding it.
+- ``# graftlint: disable=<rule>[,<rule>]`` on a finding's line (or its
+  ``def`` line, suppressing the whole function) — inline suppression.
+- ``# graftlint: disable-file=<rule>[,<rule>]`` anywhere — suppresses
+  a rule for the whole file.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# Rule ids (the catalog; docs/STATIC_ANALYSIS.md documents each).
+LOCK_ORDER = "lock-order"
+LOCK_CYCLE = "lock-cycle"
+UNANNOTATED_LOCK = "unannotated-lock"
+GUARDED_BY = "guarded-by"
+CALLED_UNDER = "called-under"
+SYNC_UNDER_LOCK = "sync-under-lock"
+JIT_TRACED_BRANCH = "jit-traced-branch"
+JIT_NONSTATIC_CLOSURE = "jit-nonstatic-closure"
+USE_AFTER_DONATE = "use-after-donate"
+SWALLOWED_EXCEPTION = "swallowed-exception"
+
+ALL_RULES = (
+    LOCK_ORDER, LOCK_CYCLE, UNANNOTATED_LOCK, GUARDED_BY, CALLED_UNDER,
+    SYNC_UNDER_LOCK, JIT_TRACED_BRANCH, JIT_NONSTATIC_CLOSURE,
+    USE_AFTER_DONATE, SWALLOWED_EXCEPTION,
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``fingerprint`` is line-number-free so the
+    baseline survives unrelated edits: (rule, path, scope, detail)."""
+
+    rule: str
+    path: str  # repo-relative
+    line: int
+    scope: str  # enclosing qualname ("mod", "Class.meth", ...)
+    message: str
+    detail: str  # stable discriminator (no line numbers)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.path}::{self.scope}::{self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.message}  (in {self.scope})")
+
+
+# A lock reference as the visitor sees an acquisition or annotation:
+# (base, attr, mode). ``base`` is the owner expression ("self",
+# "store", "self._store", or "<module>" for module-level locks);
+# ``mode`` is "read"/"write" for RWLock acquisitions, None for plain
+# Lock/RLock/Condition.
+LockRef = Tuple[str, str, Optional[str]]
+
+
+@dataclass
+class LockDef:
+    """One lock creation site (``self._x = threading.Lock()`` or a
+    module-level twin)."""
+
+    key: str  # canonical "Class.attr" or "module.attr"
+    kind: str  # "lock" | "rlock" | "condition" | "rwlock"
+    path: str
+    line: int
+    rank: Optional[int] = None  # from "# lock-order: N"
+    flags: Tuple[str, ...] = ()  # extra markers after the rank
+
+
+@dataclass
+class Acquisition:
+    """One ``with <lock>:`` entered while ``held`` were already held
+    (innermost-last)."""
+
+    ref: LockRef
+    held: Tuple[LockRef, ...]
+    line: int
+    func: str  # qualname of the enclosing function
+
+
+@dataclass
+class AttrAccess:
+    """One attribute read/write: ``base.attr`` with the lexically held
+    locks at that point."""
+
+    base: str
+    attr: str
+    is_store: bool
+    held: Tuple[LockRef, ...]
+    line: int
+    func: str
+
+
+@dataclass
+class CallSite:
+    """One call with enough structure to resolve package-internal
+    targets. ``callee`` is one of:
+    ("self", meth) / ("name", fn) / ("mod", alias, fn) /
+    ("selfattr", attr, meth) / ("local", var, meth)."""
+
+    callee: Tuple[str, ...]
+    held: Tuple[LockRef, ...]
+    line: int
+    func: str
+
+
+@dataclass
+class SyncCall:
+    """A host-synchronizing call (jax.device_get /
+    block_until_ready / np.asarray) and the locks held around it."""
+
+    what: str
+    held: Tuple[LockRef, ...]
+    line: int
+    func: str
+
+
+@dataclass
+class ExceptInfo:
+    """One broad ``except`` clause (Exception/BaseException/bare)."""
+
+    line: int
+    func: str
+    bound_name: Optional[str]
+    handles: bool  # re-raises, uses the exception, or logs/counts
+
+
+@dataclass
+class JitFunc:
+    """A module-level jitted function (@partial(jax.jit, ...) or
+    ``name = jax.jit(fn, ...)``)."""
+
+    name: str
+    params: Tuple[str, ...]
+    static_params: Tuple[str, ...]
+    donate_params: Tuple[str, ...]
+    donate_idx: Tuple[int, ...]
+    line: int
+
+
+@dataclass
+class FuncModel:
+    qualname: str
+    line: int
+    cls: Optional[str]  # owning class name or None
+    called_under: Tuple[LockRef, ...] = ()
+    acquisitions: List[Acquisition] = field(default_factory=list)
+    accesses: List[AttrAccess] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    syncs: List[SyncCall] = field(default_factory=list)
+    excepts: List[ExceptInfo] = field(default_factory=list)
+    suppressed: Tuple[str, ...] = ()  # def-line disable=... rules
+
+
+@dataclass
+class ClassModel:
+    name: str
+    line: int
+    bases: Tuple[str, ...]
+    lock_attrs: Dict[str, LockDef] = field(default_factory=dict)
+    # attr -> (lock attr, mode) from "# guarded-by:" annotations
+    guarded: Dict[str, Tuple[str, Optional[str]]] = (
+        field(default_factory=dict))
+    # attr -> class name (resolved in-package) for self.attr.m() calls
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    # attr -> assignment line in __init__ (for --fix-annotations)
+    attr_init_lines: Dict[str, int] = field(default_factory=dict)
+    methods: Dict[str, FuncModel] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleModel:
+    path: str  # repo-relative
+    modname: str  # dotted ("zipkin_tpu.store.tpu")
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    functions: Dict[str, FuncModel] = field(default_factory=dict)
+    module_locks: Dict[str, LockDef] = field(default_factory=dict)
+    jit_funcs: Dict[str, JitFunc] = field(default_factory=dict)
+    # import alias -> dotted module ("dev" -> "zipkin_tpu.store.device")
+    imports: Dict[str, str] = field(default_factory=dict)
+    # imported name -> (module, name) for "from X import Y [as Z]"
+    from_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    file_suppressed: Set[str] = field(default_factory=set)
+    comments: Dict[int, str] = field(default_factory=dict)
+    findings: List[Finding] = field(default_factory=list)
+
+    def all_funcs(self) -> List[FuncModel]:
+        out = list(self.functions.values())
+        for c in self.classes.values():
+            out.extend(c.methods.values())
+        return out
+
+
+_DISABLE_RE = re.compile(r"graftlint:\s*disable=([\w,\- ]+)")
+_DISABLE_FILE_RE = re.compile(r"graftlint:\s*disable-file=([\w,\- ]+)")
+_LOCK_ORDER_RE = re.compile(r"lock-order:\s*(\d+)((?:\s+[\w\-]+)*)")
+_GUARDED_RE = re.compile(r"guarded-by:\s*([\w\.]+)")
+_CALLED_UNDER_RE = re.compile(r"called-under:\s*([\w\.]+)")
+
+
+def extract_comments(source: str) -> Dict[int, str]:
+    """line -> comment text, via tokenize (robust against '#' inside
+    strings, which a regex scan would misread)."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        pass
+    return out
+
+
+def parse_disables(comment: str) -> Tuple[str, ...]:
+    m = _DISABLE_RE.search(comment)
+    if not m:
+        return ()
+    return tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+
+
+def parse_file_disables(comment: str) -> Tuple[str, ...]:
+    m = _DISABLE_FILE_RE.search(comment)
+    if not m:
+        return ()
+    return tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+
+
+def parse_lock_order(comment: str):
+    """(rank, flags) from '# lock-order: 40 no-sync ...', or None."""
+    m = _LOCK_ORDER_RE.search(comment)
+    if not m:
+        return None
+    flags = tuple(f for f in m.group(2).split() if f)
+    return int(m.group(1)), flags
+
+
+def parse_guarded_by(comment: str):
+    """(lock attr, mode) from '# guarded-by: _lock' or
+    '# guarded-by: _rw.write', or None."""
+    m = _GUARDED_RE.search(comment)
+    if not m:
+        return None
+    spec = m.group(1)
+    if "." in spec:
+        attr, mode = spec.split(".", 1)
+        return attr, mode
+    return spec, None
+
+
+def parse_called_under(comment: str):
+    m = _CALLED_UNDER_RE.search(comment)
+    if not m:
+        return None
+    spec = m.group(1)
+    if "." in spec:
+        attr, mode = spec.split(".", 1)
+        return ("self", attr, mode)
+    return ("self", spec, None)

@@ -1,0 +1,256 @@
+"""graftlint project loader: parses every package file, links classes
+and call sites across modules, and computes the fixed-point transitive
+facts the lock rules need (which locks a call may acquire, which calls
+may synchronize with the device).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from zipkin_tpu.analysis.model import (
+    ClassModel,
+    FuncModel,
+    LockDef,
+    LockRef,
+    ModuleModel,
+)
+from zipkin_tpu.analysis.visitor import (
+    ModuleVisitor,
+    collect_lock_attr_names,
+)
+
+# Function key: (modname, qualname) — unique across the project.
+FuncKey = Tuple[str, str]
+
+
+def _modname_for(root: str, path: str) -> str:
+    rel = os.path.relpath(path, os.path.dirname(root))
+    return rel[:-3].replace(os.sep, ".")
+
+
+def iter_py_files(pkg_dir: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return out
+
+
+class Project:
+    """Every module's model plus cross-module resolution tables."""
+
+    def __init__(self, modules: List[ModuleModel], repo_root: str):
+        self.modules = modules
+        self.repo_root = repo_root
+        # Class name -> (module, ClassModel). Private class names are
+        # unique enough in one package; a collision keeps the first
+        # and rules fall back to per-module lookups.
+        self.classes: Dict[str, Tuple[ModuleModel, ClassModel]] = {}
+        for m in modules:
+            for c in m.classes.values():
+                self.classes.setdefault(c.name, (m, c))
+        self.funcs: Dict[FuncKey, FuncModel] = {}
+        for m in modules:
+            for f in m.all_funcs():
+                self.funcs[(m.modname, f.qualname)] = f
+        # lock key -> LockDef (class-attr + module-level locks).
+        self.locks: Dict[str, LockDef] = {}
+        for m in modules:
+            for c in m.classes.values():
+                for d in c.lock_attrs.values():
+                    self.locks[d.key] = d
+            for d in m.module_locks.values():
+                self.locks[d.key] = d
+        # attr name -> set of lock keys sharing it (canonicalizing a
+        # LockRef by attr when the owner expression isn't typeable).
+        self.locks_by_attr: Dict[str, List[LockDef]] = {}
+        for d in self.locks.values():
+            self.locks_by_attr.setdefault(
+                d.key.rsplit(".", 1)[-1], []).append(d)
+        self._transitive_acqs: Dict[FuncKey, Set[Tuple[str,
+                                                       Optional[str]]]] = {}
+        self._transitive_syncs: Dict[FuncKey, Set[str]] = {}
+        self._edge_cache = None  # filled by rules_locks.build_edges
+        self._compute_fixed_points()
+
+    # -- lock canonicalization -------------------------------------------
+
+    def canon_lock(self, module: ModuleModel, func: FuncModel,
+                   ref: LockRef) -> Optional[str]:
+        """LockRef -> canonical 'Class.attr' / 'module.attr' key.
+        Resolution order: owner is self and the enclosing class (or a
+        base) defines the attr; owner types known via attr_types;
+        otherwise the attr name maps to exactly one project lock."""
+        base, attr, _mode = ref
+        if base == "<module>":
+            d = module.module_locks.get(attr)
+            return d.key if d else None
+        if func.cls:
+            cm = module.classes.get(func.cls)
+            own = self._class_lock(cm, attr)
+            if base == "self" and own:
+                return own
+            if base.startswith("self."):
+                tname = cm.attr_types.get(base[5:]) if cm else None
+                if tname and tname in self.classes:
+                    got = self._class_lock(self.classes[tname][1], attr)
+                    if got:
+                        return got
+        cands = {d.key for d in self.locks_by_attr.get(attr, ())}
+        if len(cands) == 1:
+            return next(iter(cands))
+        if base == "self" and func.cls:
+            # Unlisted attr on a known class (inherited off-package):
+            # treat as that class's own lock.
+            return f"{func.cls}.{attr}"
+        return None
+
+    def _class_lock(self, cm: Optional[ClassModel],
+                    attr: str) -> Optional[str]:
+        seen = set()
+        while cm is not None and cm.name not in seen:
+            seen.add(cm.name)
+            if attr in cm.lock_attrs:
+                return cm.lock_attrs[attr].key
+            nxt = None
+            for b in cm.bases:
+                bname = b.rsplit(".", 1)[-1]
+                if bname in self.classes:
+                    nxt = self.classes[bname][1]
+                    break
+            cm = nxt
+        return None
+
+    # -- call resolution -------------------------------------------------
+
+    def resolve_call(self, module: ModuleModel, func: FuncModel,
+                     callee: Tuple[str, ...]) -> Optional[FuncKey]:
+        kind = callee[0]
+        if kind == "self" and func.cls:
+            cm = module.classes.get(func.cls)
+            cur_mod = module
+            seen = set()
+            while cm is not None and cm.name not in seen:
+                seen.add(cm.name)
+                if callee[1] in cm.methods:
+                    return (cur_mod.modname, f"{cm.name}.{callee[1]}")
+                nxt = None
+                for b in cm.bases:
+                    bname = b.rsplit(".", 1)[-1]
+                    if bname in self.classes:
+                        cur_mod, nxt = self.classes[bname]
+                        break
+                cm = nxt
+            return None
+        if kind == "name":
+            name = callee[1]
+            if name in module.functions:
+                return (module.modname, name)
+            imp = module.from_imports.get(name)
+            if imp:
+                target_mod, target_name = imp
+                key = (target_mod, target_name)
+                if key in self.funcs:
+                    return key
+            return None
+        if kind == "mod":
+            alias, fname = callee[1], callee[2]
+            target = module.imports.get(alias)
+            if target is None:
+                imp = module.from_imports.get(alias)
+                if imp:
+                    target = f"{imp[0]}.{imp[1]}"
+            if target and (target, fname) in self.funcs:
+                return (target, fname)
+            return None
+        if kind in ("selfattr", "paramtype"):
+            if kind == "selfattr":
+                if not func.cls:
+                    return None
+                cm = module.classes.get(func.cls)
+                tname = cm.attr_types.get(callee[1]) if cm else None
+            else:
+                tname = callee[1]
+            if tname and tname in self.classes:
+                mod, cm = self.classes[tname]
+                if callee[2] in cm.methods:
+                    return (mod.modname, f"{cm.name}.{callee[2]}")
+        return None
+
+    def module_of(self, key: FuncKey) -> ModuleModel:
+        for m in self.modules:
+            if m.modname == key[0]:
+                return m
+        raise KeyError(key)  # pragma: no cover
+
+    # -- fixed points -----------------------------------------------------
+
+    def _compute_fixed_points(self) -> None:
+        """Transitive 'may acquire' lock sets and 'may device-sync'
+        sets per function, over the resolvable call graph."""
+        acqs: Dict[FuncKey, Set[Tuple[str, Optional[str]]]] = {}
+        syncs: Dict[FuncKey, Set[str]] = {}
+        callees: Dict[FuncKey, List[FuncKey]] = {}
+        for m in self.modules:
+            for f in m.all_funcs():
+                key = (m.modname, f.qualname)
+                a = set()
+                for acq in f.acquisitions:
+                    ck = self.canon_lock(m, f, acq.ref)
+                    if ck:
+                        a.add((ck, acq.ref[2]))
+                acqs[key] = a
+                syncs[key] = {s.what for s in f.syncs}
+                callees[key] = [
+                    r for c in f.calls
+                    if (r := self.resolve_call(m, f, c.callee))
+                    is not None and r in self.funcs
+                ]
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for key, outs in callees.items():
+                for o in outs:
+                    if not acqs[o] <= acqs[key]:
+                        acqs[key] |= acqs[o]
+                        changed = True
+                    if not syncs[o] <= syncs[key]:
+                        syncs[key] |= syncs[o]
+                        changed = True
+        self._transitive_acqs = acqs
+        self._transitive_syncs = syncs
+
+    def may_acquire(self, key: FuncKey) -> Set[Tuple[str, Optional[str]]]:
+        return self._transitive_acqs.get(key, set())
+
+    def may_sync(self, key: FuncKey) -> Set[str]:
+        return self._transitive_syncs.get(key, set())
+
+
+def load_project(paths: Iterable[str], repo_root: str) -> Project:
+    """Parse ``paths`` (files or package dirs) into a linked Project."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(iter_py_files(p))
+        else:
+            files.append(p)
+    sources = {}
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            sources[f] = fh.read()
+    lock_attrs = collect_lock_attr_names(list(sources.values()))
+    modules = []
+    for f in files:
+        rel = os.path.relpath(f, repo_root)
+        modname = rel[:-3].replace(os.sep, ".")
+        mv = ModuleVisitor(rel, modname, sources[f], lock_attrs)
+        modules.append(mv.run())
+    return Project(modules, repo_root)

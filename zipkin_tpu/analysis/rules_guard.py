@@ -1,0 +1,210 @@
+"""graftlint guarded-by rule: shared attributes annotated
+``# guarded-by: <lock>`` on their ``__init__`` assignment may only be
+read/written while holding that lock.
+
+- Self accesses are enforced in the declaring class and its in-package
+  subclasses; ``__init__`` is exempt (single-threaded construction).
+- Foreign accesses (``store._sealed_upto`` from another module) are
+  enforced when the attribute is annotated in exactly ONE class: the
+  access must sit inside ``with <same base>.<lock>`` textually.
+- RWLock-guarded attributes (``# guarded-by: _rw.write``): stores
+  require the write lock; loads accept read or write.
+- A method annotated ``# called-under: <lock>`` is analyzed as holding
+  it (the *_locked helper pattern); rules_locks checks its call sites.
+
+``suggest_annotations`` powers ``scripts/lint.py --fix-annotations``:
+attributes consistently accessed under exactly one of the class's own
+locks get the annotation written for them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from zipkin_tpu.analysis.model import (
+    Finding,
+    GUARDED_BY,
+)
+from zipkin_tpu.analysis.project import Project
+
+
+def _mode_ok(required_mode: Optional[str], is_store: bool,
+             held_mode: Optional[str]) -> bool:
+    if required_mode is None:
+        return True
+    if is_store:
+        return held_mode == "write"
+    return held_mode in ("read", "write")
+
+
+def _held_satisfies(held, base: str, lock_attr: str,
+                    required_mode: Optional[str],
+                    is_store: bool) -> bool:
+    for (hb, ha, hm) in held:
+        if ha != lock_attr:
+            continue
+        if hb != base:
+            continue
+        if _mode_ok(required_mode, is_store, hm):
+            return True
+    return False
+
+
+def _subclasses_of(project: Project, name: str) -> List[str]:
+    out = [name]
+    changed = True
+    while changed:
+        changed = False
+        for cname, (_m, cm) in project.classes.items():
+            if cname in out:
+                continue
+            for b in cm.bases:
+                if b.rsplit(".", 1)[-1] in out:
+                    out.append(cname)
+                    changed = True
+                    break
+    return out
+
+
+def check_guarded_by(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    # Global map for foreign-access enforcement: attr -> unique
+    # (class, lock, mode) or None when ambiguous.
+    foreign: Dict[str, Optional[Tuple[str, str, Optional[str]]]] = {}
+    for cname, (_m, cm) in project.classes.items():
+        for attr, (lock, mode) in cm.guarded.items():
+            if attr in foreign:
+                foreign[attr] = None  # ambiguous across classes
+            else:
+                foreign[attr] = (cname, lock, mode)
+
+    # Self accesses, per declaring class + in-package subclasses.
+    for cname, (mod, cm) in project.classes.items():
+        if not cm.guarded:
+            continue
+        family = _subclasses_of(project, cname)
+        for sub in family:
+            smod, scm = project.classes[sub]
+            for mname, f in scm.methods.items():
+                if mname == "__init__":
+                    continue
+                for acc in f.accesses:
+                    if acc.base != "self" or acc.attr not in cm.guarded:
+                        continue
+                    lock, mode = cm.guarded[acc.attr]
+                    if _held_satisfies(
+                            acc.held + tuple(f.called_under), "self",
+                            lock, mode, acc.is_store):
+                        continue
+                    kind = "write of" if acc.is_store else "read of"
+                    out.append(Finding(
+                        rule=GUARDED_BY, path=smod.path, line=acc.line,
+                        scope=f.qualname,
+                        message=(f"{kind} {cname}.{acc.attr} without "
+                                 f"holding {lock}"
+                                 + (f".{mode}" if mode else "")
+                                 + " (declared '# guarded-by' on its "
+                                   "__init__ assignment)"),
+                        detail=f"{cname}.{acc.attr}|"
+                               f"{'store' if acc.is_store else 'load'}"))
+
+    # Foreign accesses: obj._attr where _attr is uniquely annotated.
+    # PRIVATE attrs only — public twin names are shared by design
+    # (the device StoreState fields mirror SketchMirror's arrays), so
+    # name-matching a public attr across types would cry wolf.
+    for m in project.modules:
+        for f in m.all_funcs():
+            for acc in f.accesses:
+                if acc.base in ("self", "<expr>"):
+                    continue
+                if not acc.attr.startswith("_"):
+                    continue
+                spec = foreign.get(acc.attr)
+                if spec is None:
+                    continue
+                cname, lock, mode = spec
+                # Skip accesses from the declaring family (handled
+                # above via self; other bases in-family are aliases we
+                # can't type — only flag clearly-foreign modules).
+                if f.cls and f.cls in _subclasses_of(project, cname):
+                    continue
+                if _held_satisfies(acc.held, acc.base, lock, mode,
+                                   acc.is_store):
+                    continue
+                kind = "write of" if acc.is_store else "read of"
+                out.append(Finding(
+                    rule=GUARDED_BY, path=m.path, line=acc.line,
+                    scope=f.qualname,
+                    message=(f"{kind} {acc.base}.{acc.attr} "
+                             f"({cname}.{acc.attr} is guarded by "
+                             f"{lock}"
+                             + (f".{mode}" if mode else "")
+                             + f") outside 'with {acc.base}.{lock}'"),
+                    detail=f"foreign:{cname}.{acc.attr}|"
+                           f"{acc.base}|"
+                           f"{'store' if acc.is_store else 'load'}"))
+    return out
+
+
+def suggest_annotations(project: Project) -> List[Tuple[str, int, str,
+                                                        str]]:
+    """(path, line, attr, lock) proposals: private attrs assigned in
+    __init__, unannotated, accessed >= 2 times outside __init__, and
+    ALWAYS under exactly one of the class's own locks."""
+    out = []
+    for cname in sorted(project.classes):
+        mod, cm = project.classes[cname]
+        if not cm.lock_attrs:
+            continue
+        for attr, line in sorted(cm.attr_init_lines.items()):
+            if (not attr.startswith("_") or attr in cm.guarded
+                    or attr in cm.lock_attrs):
+                continue
+            locks_seen = set()
+            n = 0
+            ok = True
+            for mname, f in cm.methods.items():
+                if mname == "__init__":
+                    continue
+                for acc in f.accesses:
+                    if acc.base != "self" or acc.attr != attr:
+                        continue
+                    n += 1
+                    held_own = {
+                        ha for (hb, ha, _hm) in
+                        acc.held + tuple(f.called_under)
+                        if hb == "self" and ha in cm.lock_attrs
+                    }
+                    if not held_own:
+                        ok = False
+                    locks_seen.update(held_own)
+            if ok and n >= 2 and len(locks_seen) == 1:
+                out.append((mod.path, line, attr, locks_seen.pop()))
+    return out
+
+
+def apply_annotations(repo_root: str,
+                      proposals: List[Tuple[str, int, str, str]],
+                      ) -> List[str]:
+    """Append '# guarded-by: <lock>' to each proposed __init__
+    assignment line. Returns human-readable edit descriptions."""
+    import os
+
+    edits: Dict[str, List[Tuple[int, str, str]]] = {}
+    for path, line, attr, lock in proposals:
+        edits.setdefault(path, []).append((line, attr, lock))
+    done = []
+    for path, items in edits.items():
+        full = os.path.join(repo_root, path)
+        with open(full, "r", encoding="utf-8") as fh:
+            lines = fh.readlines()
+        for line, attr, lock in sorted(items, reverse=True):
+            idx = line - 1
+            if idx >= len(lines) or "guarded-by" in lines[idx]:
+                continue
+            text = lines[idx].rstrip("\n")
+            lines[idx] = f"{text}  # guarded-by: {lock}\n"
+            done.append(f"{path}:{line}: {attr} -> guarded-by: {lock}")
+        with open(full, "w", encoding="utf-8") as fh:
+            fh.writelines(lines)
+    return done

@@ -1,0 +1,353 @@
+"""graftlint JAX hazard rules.
+
+- ``jit-traced-branch`` — a Python ``if``/``while`` inside a jitted
+  function whose test reads a NON-static parameter: the branch runs at
+  trace time on a tracer (ConcretizationTypeError at best, a silent
+  per-value recompile at worst). Static parameters (static_argnums /
+  static_argnames) legitimately branch.
+- ``jit-nonstatic-closure`` — a jitted function closing over a
+  lowercase module-level scalar (or a module global assigned more than
+  once): each new value bakes a new compile-cache entry, breaking the
+  zero-steady-state-recompile gate.
+- ``use-after-donate`` — an argument passed in a ``donate_argnums``
+  position is read again after the call without being rebound: its
+  device buffer was donated and may already be freed/reused.
+
+All three are intentionally narrow heuristics (fixture-corpus-pinned in
+tests/test_analysis.py); anything subtler belongs in review, not in a
+gate that must never cry wolf.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from zipkin_tpu.analysis.model import (
+    Finding,
+    JIT_NONSTATIC_CLOSURE,
+    JIT_TRACED_BRANCH,
+    USE_AFTER_DONATE,
+)
+from zipkin_tpu.analysis.project import Project
+from zipkin_tpu.analysis.visitor import _expr_str
+
+
+def _walk_pruned(root: ast.AST):
+    """ast.walk minus nested function/lambda subtrees: they execute in
+    a different trace scope (ast.walk cannot prune — a bare `continue`
+    skips only the def node itself, not its children)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _parse(project: Project, module) -> Optional[ast.Module]:
+    full = os.path.join(project.repo_root, module.path)
+    try:
+        with open(full, "r", encoding="utf-8") as fh:
+            return ast.parse(fh.read())
+    except (OSError, SyntaxError):  # pragma: no cover
+        return None
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def _module_scalars(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(literal-scalar assigns, reassigned-names) at module level."""
+    counts: Dict[str, int] = {}
+    literal: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    counts[t.id] = counts.get(t.id, 0) + 1
+                    if isinstance(node.value, ast.Constant) and (
+                            isinstance(node.value.value,
+                                       (int, float, bool))):
+                        literal.add(t.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Name):
+            counts[node.target.id] = counts.get(node.target.id, 0) + 2
+    reassigned = {n for n, c in counts.items() if c > 1}
+    return literal, reassigned
+
+
+def _is_none_check(test: ast.AST, name: str) -> bool:
+    """True when every occurrence of ``name`` in ``test`` is an
+    ``is None`` / ``is not None`` operand — Noneness of an optional
+    argument is STRUCTURAL at trace time (it keys the jit cache), not
+    a branch on a traced value."""
+    safe = set()
+    for node in ast.walk(test):
+        if (isinstance(node, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops)):
+            for side in (node.left, *node.comparators):
+                if isinstance(side, ast.Name):
+                    safe.add(id(side))
+    for node in ast.walk(test):
+        if (isinstance(node, ast.Name) and node.id == name
+                and isinstance(node.ctx, ast.Load)
+                and id(node) not in safe):
+            return False
+    return True
+
+
+def _jit_fn_defs(project: Project, module,
+                 tree: ast.Module) -> List[ast.FunctionDef]:
+    names = set(module.jit_funcs)
+    return [n for n in tree.body
+            if isinstance(n, ast.FunctionDef) and n.name in names]
+
+
+def check_jit_rules(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for m in project.modules:
+        if not m.jit_funcs:
+            continue
+        tree = _parse(project, m)
+        if tree is None:
+            continue
+        literal_scalars, reassigned = _module_scalars(tree)
+        for fn in _jit_fn_defs(project, m, tree):
+            info = m.jit_funcs[fn.name]
+            traced = set(info.params) - set(info.static_params)
+            locals_ = _local_names(fn) | set(info.params)
+            seen_branch: Set[str] = set()
+            seen_closure: Set[str] = set()
+            for node in _walk_pruned(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    for name in ast.walk(node.test):
+                        if (isinstance(name, ast.Name)
+                                and isinstance(name.ctx, ast.Load)
+                                and name.id in traced
+                                and name.id not in seen_branch
+                                and not _is_none_check(node.test,
+                                                       name.id)):
+                            seen_branch.add(name.id)
+                            out.append(Finding(
+                                rule=JIT_TRACED_BRANCH, path=m.path,
+                                line=node.lineno, scope=fn.name,
+                                message=(
+                                    f"Python branch on traced "
+                                    f"parameter '{name.id}' inside "
+                                    f"jitted {fn.name} — use lax.cond/"
+                                    "jnp.where, or mark the argument "
+                                    "static"),
+                                detail=f"{fn.name}|{name.id}"))
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id not in locals_
+                        and node.id not in seen_closure):
+                    bad_literal = (node.id in literal_scalars
+                                   and not node.id.isupper())
+                    if bad_literal or node.id in reassigned:
+                        why = ("reassigned module global"
+                               if node.id in reassigned else
+                               "lowercase module-level scalar")
+                        seen_closure.add(node.id)
+                        out.append(Finding(
+                            rule=JIT_NONSTATIC_CLOSURE, path=m.path,
+                            line=node.lineno, scope=fn.name,
+                            message=(
+                                f"jitted {fn.name} closes over "
+                                f"{why} '{node.id}' — each new value "
+                                "is a fresh compile-cache entry "
+                                "(steady-state recompile hazard)"),
+                            detail=f"{fn.name}|{node.id}"))
+    return out
+
+
+# -- use-after-donate -----------------------------------------------------
+
+
+def _donating_registry(project: Project) -> Dict[Tuple[str, str],
+                                                 Tuple[int, ...]]:
+    """(modname, fn name) -> donate_argnums for every module-level
+    jitted function that donates."""
+    out = {}
+    for m in project.modules:
+        for jf in m.jit_funcs.values():
+            if jf.donate_idx:
+                out[(m.modname, jf.name)] = jf.donate_idx
+    return out
+
+
+class _DonateScanner:
+    """Linear statement walk of one function body: donations enter a
+    live set keyed by the donated argument's expression string; a
+    rebind clears it; a later read of a live donated expression is a
+    finding. Branches are scanned in order; loop bodies once (a
+    donation rebound by its own enclosing statement never enters the
+    set, so the common ``state = step(state, ...)`` loop is clean)."""
+
+    def __init__(self, project: Project, module, registry):
+        self.project = project
+        self.module = module
+        self.registry = registry
+        # Local aliases of donating callables:
+        #   step = dev.ingest_steps if chained else dev.ingest_step
+        self.aliases: Dict[str, Tuple[int, ...]] = {}
+        self.donated: Dict[str, int] = {}
+        self.findings: List[Finding] = []
+        self.scope = "?"
+
+    def _donate_idx_of(self, func: ast.AST) -> Optional[Tuple[int, ...]]:
+        if isinstance(func, ast.Name):
+            if func.id in self.aliases:
+                return self.aliases[func.id]
+            key = (self.module.modname, func.id)
+            if key in self.registry:
+                return self.registry[key]
+            imp = self.module.from_imports.get(func.id)
+            if imp and (imp[0], imp[1]) in self.registry:
+                return self.registry[(imp[0], imp[1])]
+        elif isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name):
+            target = self.module.imports.get(func.value.id)
+            if target and (target, func.attr) in self.registry:
+                return self.registry[(target, func.attr)]
+        return None
+
+    def _alias_value_idx(self, value: ast.AST) -> Optional[Tuple[int, ...]]:
+        if isinstance(value, ast.IfExp):
+            a = self._alias_value_idx(value.body)
+            b = self._alias_value_idx(value.orelse)
+            if a and b:
+                return tuple(sorted(set(a) | set(b)))
+            return a or b
+        if isinstance(value, (ast.Name, ast.Attribute)):
+            return self._donate_idx_of(value)
+        return None
+
+    def run(self, fn, scope: str) -> None:
+        self.scope = scope
+        self.donated.clear()
+        self.aliases.clear()
+        self._stmts(fn.body)
+
+    def _stmts(self, body) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        # Compound statements: scan only the header expression here,
+        # then recurse into the bodies statement-by-statement (so a
+        # donation in an earlier statement is live for later ones, and
+        # nothing is scanned twice).
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_reads(stmt.test, [])
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_reads(stmt.iter, [])
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_reads(item.context_expr, [])
+            self._stmts(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+            return
+        targets: List[str] = []
+        if isinstance(stmt, ast.Assign):
+            idx = self._alias_value_idx(stmt.value)
+            tnames = [t for t in stmt.targets if isinstance(t, ast.Name)]
+            if idx and len(tnames) == len(stmt.targets) == 1:
+                self.aliases[tnames[0].id] = idx
+                return
+            targets = [_expr_str(t) for t in stmt.targets]
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [_expr_str(stmt.target)]
+        # Reads first (RHS evaluates before the rebind), except the
+        # donating call's own arguments.
+        self._scan_reads(stmt, targets)
+        for t in targets:
+            self.donated.pop(t, None)
+
+    def _scan_reads(self, stmt, rebinds: List[str]) -> None:
+        nodes = ([stmt] if not isinstance(stmt, ast.stmt)
+                 else []) + list(_walk_pruned(stmt))
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                idx = self._donate_idx_of(node.func)
+                if idx:
+                    for i in idx:
+                        if i < len(node.args):
+                            e = _expr_str(node.args[i])
+                            if e != "<expr>" and e not in rebinds:
+                                self.donated[e] = node.lineno
+            elif isinstance(node, (ast.Name, ast.Attribute)) and (
+                    isinstance(getattr(node, "ctx", None), ast.Load)):
+                e = _expr_str(node)
+                if e in self.donated:
+                    # The donating call itself (its args walk through
+                    # here) — skip reads on the donation line.
+                    if node.lineno == self.donated[e]:
+                        continue
+                    self.findings.append(Finding(
+                        rule=USE_AFTER_DONATE, path=self.module.path,
+                        line=node.lineno, scope=self.scope,
+                        message=(
+                            f"'{e}' was donated to a jitted function "
+                            f"(donate_argnums) and read again — its "
+                            "device buffer may already be freed; "
+                            "rebind the result or copy first"),
+                        detail=f"{self.scope}|{e}"))
+                    self.donated.pop(e, None)
+
+
+def check_use_after_donate(project: Project) -> List[Finding]:
+    registry = _donating_registry(project)
+    if not registry:
+        return []
+    out: List[Finding] = []
+    for m in project.modules:
+        tree = _parse(project, m)
+        if tree is None:
+            continue
+        scanner = _DonateScanner(project, m, registry)
+
+        def scan(fn, scope):
+            scanner.findings = []
+            scanner.run(fn, scope)
+            out.extend(scanner.findings)
+
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                scan(node, node.name)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        scan(sub, f"{node.name}.{sub.name}")
+    return out

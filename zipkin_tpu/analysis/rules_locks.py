@@ -1,0 +1,305 @@
+"""graftlint lock rules: acquisition-order, cycles, unannotated locks,
+device-sync-under-write-lock, and called-under call-site checks.
+
+The canonical acquisition order is DECLARED IN CODE: every lock
+creation line carries ``# lock-order: <rank>`` (lower = outermost).
+The analyzer rebuilds the acquisition graph (lexical with-nesting plus
+resolvable-call propagation) and flags:
+
+- ``lock-order``  — acquiring a lock whose rank is <= an already-held
+  lock's rank (the ordering that makes ABBA deadlocks impossible);
+- ``lock-cycle``  — a cycle in the acquisition graph (including
+  self-edges on non-reentrant locks);
+- ``unannotated-lock`` — a Lock/RLock/Condition/RWLock creation with
+  no ``# lock-order`` annotation (every lock must place itself);
+- ``sync-under-lock`` — jax.device_get / block_until_ready /
+  np.asarray reachable while holding an RWLock WRITE region (the
+  donating-commit stall class r10 fixed by hand in the WAL group
+  commit), or any lock annotated ``no-sync``;
+- ``called-under`` — a call to a method annotated
+  ``# called-under: <lock>`` from a site that doesn't hold it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from zipkin_tpu.analysis.model import (
+    CALLED_UNDER,
+    Finding,
+    LOCK_CYCLE,
+    LOCK_ORDER,
+    SYNC_UNDER_LOCK,
+    UNANNOTATED_LOCK,
+)
+from zipkin_tpu.analysis.project import Project
+
+# One acquisition-graph edge: held -> acquired, with its evidence site.
+Edge = Tuple[str, str, str, int, str, str]  # a, b, path, line, func, via
+
+
+def build_edges(project: Project) -> List[Edge]:
+    """Acquisition edges, memoized on the Project (check_lock_order and
+    check_lock_cycles both consume the same list in one analyze run)."""
+    cached = getattr(project, "_edge_cache", None)
+    if cached is not None:
+        return cached
+    edges: List[Edge] = []
+    for m in project.modules:
+        for f in m.all_funcs():
+            for acq in f.acquisitions:
+                b = project.canon_lock(m, f, acq.ref)
+                if not b:
+                    continue
+                for href in acq.held:
+                    a = project.canon_lock(m, f, href)
+                    if a and a != b:
+                        edges.append((a, b, m.path, acq.line,
+                                      f.qualname, "with"))
+                    elif a == b and acq.ref[2] is None:
+                        # Re-entering a non-reentrant lock.
+                        kind = project.locks.get(b)
+                        if kind is not None and kind.kind != "rlock":
+                            edges.append((a, b, m.path, acq.line,
+                                          f.qualname, "re-enter"))
+            for call in f.calls:
+                if not call.held:
+                    continue
+                target = project.resolve_call(m, f, call.callee)
+                if target is None:
+                    continue
+                inner = project.may_acquire(target)
+                if not inner:
+                    continue
+                held_keys = set()
+                for href in call.held:
+                    a = project.canon_lock(m, f, href)
+                    if a:
+                        held_keys.add(a)
+                for (b, _mode) in inner:
+                    for a in held_keys:
+                        if a != b:
+                            edges.append((
+                                a, b, m.path, call.line, f.qualname,
+                                f"call {target[1]}"))
+    project._edge_cache = edges
+    return edges
+
+
+def check_lock_order(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[Tuple[str, str, str, str]] = set()
+    for a, b, path, line, func, via in build_edges(project):
+        if via == "re-enter":
+            continue  # reported by lock-cycle as a self-cycle
+        da, db = project.locks.get(a), project.locks.get(b)
+        if da is None or db is None:
+            continue
+        if da.rank is None or db.rank is None:
+            continue  # unannotated-lock reports the missing rank
+        if da.rank >= db.rank:
+            key = (a, b, path, func)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                rule=LOCK_ORDER, path=path, line=line, scope=func,
+                message=(f"acquires {b} (rank {db.rank}) while "
+                         f"holding {a} (rank {da.rank}) via {via}; "
+                         "canonical order requires strictly "
+                         "increasing ranks"),
+                detail=f"{a}->{b}"))
+    return out
+
+
+def check_lock_cycles(project: Project) -> List[Finding]:
+    edges = build_edges(project)
+    graph: Dict[str, Set[str]] = {}
+    evidence: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    out: List[Finding] = []
+    for a, b, path, line, func, via in edges:
+        if via == "re-enter":
+            out.append(Finding(
+                rule=LOCK_CYCLE, path=path, line=line, scope=func,
+                message=f"re-enters non-reentrant lock {a} "
+                        "(self-deadlock)",
+                detail=f"self:{a}"))
+            continue
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+        evidence.setdefault((a, b), (path, line, func))
+    # Tarjan SCC over the acquisition graph.
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    for scc in sccs:
+        a = scc[0]
+        nxt = next((b for b in scc[1:] if b in graph.get(a, ())), a)
+        path, line, func = evidence.get((a, nxt), ("", 0, "?"))
+        out.append(Finding(
+            rule=LOCK_CYCLE, path=path, line=line, scope=func,
+            message=("lock acquisition cycle: "
+                     + " -> ".join(scc + [scc[0]])),
+            detail="cycle:" + ",".join(scc)))
+    return out
+
+
+def check_unannotated(project: Project) -> List[Finding]:
+    out = []
+    for key in sorted(project.locks):
+        d = project.locks[key]
+        if d.rank is None:
+            out.append(Finding(
+                rule=UNANNOTATED_LOCK, path=d.path, line=d.line,
+                scope=key,
+                message=(f"lock {key} has no '# lock-order: <rank>' "
+                         "annotation — every lock must declare its "
+                         "place in the canonical acquisition order"),
+                detail=key))
+    return out
+
+
+def _write_regions_held(project: Project, module, func,
+                        held) -> Optional[str]:
+    """The canonical key of a held no-sync region (an RWLock held in
+    write mode, or any lock flagged ``no-sync``), else None."""
+    for href in held:
+        key = project.canon_lock(module, func, href)
+        if key is None:
+            continue
+        d = project.locks.get(key)
+        if d is None:
+            continue
+        if d.kind == "rwlock" and href[2] == "write":
+            return key
+        if "no-sync" in d.flags:
+            return key
+    return None
+
+
+def check_sync_under_lock(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    seen = set()
+    for m in project.modules:
+        for f in m.all_funcs():
+            for s in f.syncs:
+                key = _write_regions_held(project, m, f, s.held)
+                if key is None:
+                    continue
+                fp = (m.path, f.qualname, s.what, key)
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                out.append(Finding(
+                    rule=SYNC_UNDER_LOCK, path=m.path, line=s.line,
+                    scope=f.qualname,
+                    message=(f"{s.what} inside the {key} write-lock "
+                             "region — a host/device sync stalls "
+                             "every writer behind this hold"),
+                    detail=f"{s.what}|{key}"))
+            for call in f.calls:
+                key = _write_regions_held(project, m, f, call.held)
+                if key is None:
+                    continue
+                target = project.resolve_call(m, f, call.callee)
+                if target is None:
+                    continue
+                inner = project.may_sync(target)
+                if not inner:
+                    continue
+                what = ",".join(sorted(inner))
+                fp = (m.path, f.qualname, target[1], key)
+                if fp in seen:
+                    continue
+                seen.add(fp)
+                out.append(Finding(
+                    rule=SYNC_UNDER_LOCK, path=m.path, line=call.line,
+                    scope=f.qualname,
+                    message=(f"call to {target[1]} (which may run "
+                             f"{what}) inside the {key} write-lock "
+                             "region"),
+                    detail=f"call:{target[1]}|{key}"))
+    return out
+
+
+def check_called_under(project: Project) -> List[Finding]:
+    """Call sites of ``# called-under:``-annotated methods must hold
+    the declared lock (attr+mode matched; base expression is not
+    required to match — a linter-grade check, not a proof)."""
+    out: List[Finding] = []
+    annotated: Dict[Tuple[str, str], Tuple[str, Optional[str]]] = {}
+    for m in project.modules:
+        for f in m.all_funcs():
+            for (base, attr, mode) in f.called_under:
+                annotated[(m.modname, f.qualname)] = (attr, mode)
+    if not annotated:
+        return out
+    for m in project.modules:
+        for f in m.all_funcs():
+            for call in f.calls:
+                target = project.resolve_call(m, f, call.callee)
+                if target is None or target not in annotated:
+                    continue
+                attr, mode = annotated[target]
+                ok = False
+                for (_b, a, hm) in call.held + tuple(f.called_under):
+                    if a != attr:
+                        continue
+                    if mode is None or hm == mode or hm == "write":
+                        ok = True
+                        break
+                if not ok:
+                    out.append(Finding(
+                        rule=CALLED_UNDER, path=m.path,
+                        line=call.line, scope=f.qualname,
+                        message=(f"calls {target[1]} without holding "
+                                 f"{attr}"
+                                 + (f".{mode}" if mode else "")
+                                 + f" (declared '# called-under' on "
+                                   f"{target[1]})"),
+                        detail=f"{target[1]}|{attr}"))
+    return out

@@ -1,0 +1,34 @@
+"""graftlint swallowed-exception rule: a broad ``except Exception:``
+(or bare ``except:`` / ``except BaseException:``) must re-raise, use
+the bound exception (park it, wrap it, attach it), or log/count it
+(logging call or an obs-registry counter bump). A handler that
+silently drops the error hides exactly the class of failure the obs
+layer (PR 2) exists to surface.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from zipkin_tpu.analysis.model import Finding, SWALLOWED_EXCEPTION
+from zipkin_tpu.analysis.project import Project
+
+
+def check_swallowed(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for m in project.modules:
+        ordinals: Dict[str, int] = {}
+        for f in m.all_funcs():
+            for exc in f.excepts:
+                n = ordinals.get(f.qualname, 0)
+                ordinals[f.qualname] = n + 1
+                if exc.handles:
+                    continue
+                out.append(Finding(
+                    rule=SWALLOWED_EXCEPTION, path=m.path,
+                    line=exc.line, scope=f.qualname,
+                    message=("broad except swallows the exception — "
+                             "re-raise, park/log it, or count it via "
+                             "the obs registry"),
+                    detail=f"{f.qualname}#{n}"))
+    return out

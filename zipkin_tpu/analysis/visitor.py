@@ -1,0 +1,505 @@
+"""graftlint AST visitor: one pass per module extracting the facts the
+rules need (lock defs + acquisitions with held-lock context, attribute
+accesses, resolvable call sites, host-sync calls, broad excepts, jit
+decorations). No rule logic lives here — see rules_*.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from zipkin_tpu.analysis.model import (
+    Acquisition,
+    AttrAccess,
+    CallSite,
+    ClassModel,
+    ExceptInfo,
+    FuncModel,
+    JitFunc,
+    LockDef,
+    LockRef,
+    ModuleModel,
+    SyncCall,
+    extract_comments,
+    parse_called_under,
+    parse_disables,
+    parse_file_disables,
+    parse_guarded_by,
+    parse_lock_order,
+)
+
+# threading/concurrency constructors that define a lock.
+_LOCK_CTORS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "RWLock": "rwlock",
+}
+
+# Calls that force a host<->device synchronization (the class of stall
+# r10 moved off the append lock by hand; sync-under-lock gates it).
+_SYNC_FUNCS = {
+    ("jax", "device_get"): "jax.device_get",
+    ("jax", "block_until_ready"): "jax.block_until_ready",
+    ("np", "asarray"): "np.asarray",
+    ("numpy", "asarray"): "np.asarray",
+}
+
+# Handler-body call names that count as "handled" for
+# swallowed-exception (logging, obs counters, error parking...).
+_HANDLING_NAMES = {
+    "log", "debug", "info", "warning", "warn", "error", "exception",
+    "critical", "inc", "observe", "record", "count", "add", "put",
+    "_bump", "_count", "_park_error", "park_error", "fail", "kill",
+    "notify_all", "print_exc",
+}
+
+
+def _expr_str(node: ast.AST) -> str:
+    """Compact source-ish rendering of a name/attribute chain; opaque
+    expressions collapse to '<expr>' so fingerprints stay stable."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_expr_str(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return f"{_expr_str(node.func)}()"
+    if isinstance(node, ast.Subscript):
+        return f"{_expr_str(node.value)}[]"
+    return "<expr>"
+
+
+def _ctor_kind(call: ast.AST) -> Optional[str]:
+    """'lock'/'rlock'/'condition'/'rwlock' when ``call`` constructs
+    one (threading.Lock(), Condition(), RWLock(), ...)."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return _LOCK_CTORS.get(f.attr)
+    if isinstance(f, ast.Name):
+        return _LOCK_CTORS.get(f.id)
+    return None
+
+
+class _FuncScanner(ast.NodeVisitor):
+    """Walks ONE function body tracking the lexically held lock stack.
+    Nested function defs are skipped (they run later, under different
+    locks); nested lambdas are walked WITHOUT the held context for
+    accesses (gauge callbacks run on the exposition thread)."""
+
+    def __init__(self, module: "ModuleVisitor", fm: FuncModel,
+                 lock_attr_names: Set[str],
+                 module_lock_names: Set[str]):
+        self.module = module
+        self.fm = fm
+        self.lock_attr_names = lock_attr_names
+        self.module_lock_names = module_lock_names
+        self.held: List[LockRef] = list(fm.called_under)
+        # Local aliases: var -> ("selfattr", attr) for x = self.attr.
+        self.aliases: Dict[str, Tuple[str, str]] = {}
+
+    # -- lock reference recognition --------------------------------------
+
+    def _lock_ref(self, expr: ast.AST) -> Optional[LockRef]:
+        # with self._rw.read(): / .write()
+        if (isinstance(expr, ast.Call) and not expr.args
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr in ("read", "write")):
+            inner = expr.func.value
+            if (isinstance(inner, ast.Attribute)
+                    and inner.attr in self.lock_attr_names):
+                return (_expr_str(inner.value), inner.attr,
+                        expr.func.attr)
+            if (isinstance(inner, ast.Name)
+                    and inner.id in self.module_lock_names):
+                return ("<module>", inner.id, expr.func.attr)
+            return None
+        # with self._lock: / store._cap_lock:
+        if (isinstance(expr, ast.Attribute)
+                and expr.attr in self.lock_attr_names):
+            return (_expr_str(expr.value), expr.attr, None)
+        # with _MODULE_LOCK:
+        if (isinstance(expr, ast.Name)
+                and expr.id in self.module_lock_names):
+            return ("<module>", expr.id, None)
+        return None
+
+    # -- visitors --------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        refs = []
+        for item in node.items:
+            r = self._lock_ref(item.context_expr)
+            if r is not None:
+                refs.append(r)
+                self.fm.acquisitions.append(Acquisition(
+                    ref=r, held=tuple(self.held), line=node.lineno,
+                    func=self.fm.qualname))
+            else:
+                # Still scan non-lock context managers (open(), ...).
+                self.visit(item.context_expr)
+        self.held.extend(refs)
+        for stmt in node.body:
+            self.visit(stmt)
+        if refs:
+            del self.held[-len(refs):]
+
+    def visit_FunctionDef(self, node) -> None:
+        pass  # nested defs: separate execution context
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # A lambda (gauge callback, key fn) executes later on some
+        # other thread: record its accesses with NO held locks.
+        saved, self.held = self.held, []
+        self.visit(node.body)
+        self.held = saved
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        base = _expr_str(node.value)
+        self.fm.accesses.append(AttrAccess(
+            base=base, attr=node.attr,
+            is_store=isinstance(node.ctx, (ast.Store, ast.Del)),
+            held=tuple(self.held), line=node.lineno,
+            func=self.fm.qualname))
+        self.visit(node.value)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # Track x = self.attr aliases for call resolution.
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Attribute)
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id == "self"):
+            self.aliases[node.targets[0].id] = (
+                "selfattr", node.value.attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        callee: Optional[Tuple[str, ...]] = None
+        if isinstance(f, ast.Name):
+            callee = ("name", f.id)
+        elif isinstance(f, ast.Attribute):
+            owner = f.value
+            if isinstance(owner, ast.Name):
+                if owner.id == "self":
+                    callee = ("self", f.attr)
+                elif owner.id in self.aliases:
+                    callee = ("local-" + self.aliases[owner.id][0],
+                              self.aliases[owner.id][1], f.attr)
+                else:
+                    callee = ("mod", owner.id, f.attr)
+                key = (owner.id, f.attr)
+                if key in _SYNC_FUNCS:
+                    self.fm.syncs.append(SyncCall(
+                        what=_SYNC_FUNCS[key], held=tuple(self.held),
+                        line=node.lineno, func=self.fm.qualname))
+            elif (isinstance(owner, ast.Attribute)
+                  and isinstance(owner.value, ast.Name)
+                  and owner.value.id == "self"):
+                callee = ("selfattr", owner.attr, f.attr)
+            # Method-style sync on an arbitrary object (x.block_until_
+            # ready()); the jax.block_until_ready form was already
+            # recorded by the table above — don't double-count it.
+            if (f.attr == "block_until_ready"
+                    and not (isinstance(owner, ast.Name)
+                             and (owner.id, f.attr) in _SYNC_FUNCS)):
+                self.fm.syncs.append(SyncCall(
+                    what=".block_until_ready", held=tuple(self.held),
+                    line=node.lineno, func=self.fm.qualname))
+        if callee is not None:
+            if callee[0] == "local-selfattr":
+                callee = ("selfattr", callee[1], callee[2])
+            self.fm.calls.append(CallSite(
+                callee=callee, held=tuple(self.held), line=node.lineno,
+                func=self.fm.qualname))
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        if broad:
+            self.fm.excepts.append(ExceptInfo(
+                line=node.lineno, func=self.fm.qualname,
+                bound_name=node.name,
+                handles=_handler_handles(node)))
+        self.generic_visit(node)
+
+
+def _handler_handles(node: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises, uses the bound exception, or
+    calls something that looks like logging/counting/parking."""
+    names_used: Set[str] = set()
+    for sub in ast.walk(node):
+        if sub is node:
+            continue
+        if isinstance(sub, ast.Raise):
+            return True
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+            names_used.add(sub.id)
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if name in _HANDLING_NAMES:
+                return True
+    return node.name is not None and node.name in names_used
+
+
+def _jit_decoration(node) -> Optional[Tuple[Tuple[int, ...],
+                                            Tuple[str, ...],
+                                            Tuple[int, ...]]]:
+    """(static_argnums, static_argnames, donate_argnums) when the
+    function is decorated @jax.jit or @partial(jax.jit, ...)."""
+    for dec in node.decorator_list:
+        target = dec
+        kw = {}
+        if isinstance(dec, ast.Call):
+            fname = _expr_str(dec.func)
+            if fname in ("partial", "functools.partial") and dec.args:
+                if _expr_str(dec.args[0]) != "jax.jit":
+                    continue
+                kw = {k.arg: k.value for k in dec.keywords}
+            elif fname == "jax.jit":
+                kw = {k.arg: k.value for k in dec.keywords}
+            else:
+                continue
+        elif _expr_str(target) != "jax.jit":
+            continue
+
+        def ints(key):
+            v = kw.get(key)
+            if v is None:
+                return ()
+            try:
+                got = ast.literal_eval(v)
+            except ValueError:
+                return ()
+            if isinstance(got, int):
+                return (got,)
+            return tuple(int(x) for x in got)
+
+        def strs(key):
+            v = kw.get(key)
+            if v is None:
+                return ()
+            try:
+                got = ast.literal_eval(v)
+            except ValueError:
+                return ()
+            if isinstance(got, str):
+                return (got,)
+            return tuple(str(x) for x in got)
+
+        return ints("static_argnums"), strs("static_argnames"), (
+            ints("donate_argnums"))
+    return None
+
+
+class ModuleVisitor:
+    """Builds a ModuleModel for one source file."""
+
+    def __init__(self, path: str, modname: str, source: str,
+                 lock_attr_names: Set[str]):
+        self.model = ModuleModel(path=path, modname=modname)
+        self.model.comments = extract_comments(source)
+        for c in self.model.comments.values():
+            self.model.file_suppressed.update(parse_file_disables(c))
+        self.lock_attr_names = lock_attr_names
+        self.tree = ast.parse(source)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _comment(self, line: int) -> str:
+        return self.model.comments.get(line, "")
+
+    def _def_comment(self, node) -> str:
+        """Comment on the def line, any decorator line, or the line
+        directly above the first decorator/def."""
+        lines = [node.lineno]
+        lines.extend(d.lineno for d in node.decorator_list)
+        lines.append(min(lines) - 1)
+        return " ".join(self._comment(ln) for ln in lines)
+
+    def _lockdef(self, owner: str, attr: str, kind: str,
+                 line: int) -> LockDef:
+        ann = parse_lock_order(self._comment(line))
+        rank, flags = ann if ann else (None, ())
+        return LockDef(key=f"{owner}.{attr}", kind=kind,
+                       path=self.model.path, line=line, rank=rank,
+                       flags=flags)
+
+    # -- top-level walk --------------------------------------------------
+
+    def run(self) -> ModuleModel:
+        m = self.model
+        for node in self.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    m.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    m.from_imports[a.asname or a.name] = (
+                        node.module, a.name)
+            elif isinstance(node, ast.Assign):
+                kind = _ctor_kind(node.value)
+                for t in node.targets:
+                    if kind and isinstance(t, ast.Name):
+                        m.module_locks[t.id] = self._lockdef(
+                            m.modname.rsplit(".", 1)[-1], t.id, kind,
+                            node.lineno)
+                # name = jax.jit(fn, donate_argnums=...)
+                self._maybe_jit_assign(node)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self._scan_function(node, cls=None)
+        return m
+
+    def _maybe_jit_assign(self, node: ast.Assign) -> None:
+        v = node.value
+        if not (isinstance(v, ast.Call)
+                and _expr_str(v.func) == "jax.jit" and v.args):
+            return
+        kw = {k.arg: k.value for k in v.keywords}
+        donate = kw.get("donate_argnums")
+        idx: Tuple[int, ...] = ()
+        if donate is not None:
+            try:
+                got = ast.literal_eval(donate)
+                idx = (got,) if isinstance(got, int) else tuple(got)
+            except ValueError:
+                idx = ()
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self.model.jit_funcs[t.id] = JitFunc(
+                    name=t.id, params=(), static_params=(),
+                    donate_params=(), donate_idx=idx,
+                    line=node.lineno)
+
+    # -- class scan ------------------------------------------------------
+
+    def _scan_class(self, node: ast.ClassDef) -> None:
+        cm = ClassModel(name=node.name, line=node.lineno,
+                        bases=tuple(_expr_str(b) for b in node.bases))
+        self.model.classes[node.name] = cm
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(item, cls=node.name)
+                if item.name == "__init__":
+                    self._scan_init(item, cm)
+            elif isinstance(item, ast.AnnAssign) and isinstance(
+                    item.target, ast.Name):
+                g = parse_guarded_by(self._comment(item.lineno))
+                if g:
+                    cm.guarded[item.target.id] = g
+
+    def _scan_init(self, init, cm: ClassModel) -> None:
+        """__init__ pass: lock defs, guarded-by annotations, and
+        attribute types for call resolution."""
+        for stmt in ast.walk(init):
+            target = None
+            value = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target, value = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                target, value = stmt.target, stmt.value
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            attr = target.attr
+            cm.attr_init_lines.setdefault(attr, stmt.lineno)
+            kind = _ctor_kind(value)
+            if kind:
+                cm.lock_attrs[attr] = self._lockdef(
+                    cm.name, attr, kind, stmt.lineno)
+            g = parse_guarded_by(self._comment(stmt.lineno))
+            if g:
+                cm.guarded[attr] = g
+            # self.x = ClassName(...) -> attr type (package classes
+            # resolve later; store the bare callee name).
+            if isinstance(value, ast.Call):
+                callee = value.func
+                if isinstance(callee, ast.Name):
+                    cm.attr_types[attr] = callee.id
+            # self.x: Optional[ClassName] = None  -> annotation name
+            if (isinstance(stmt, ast.AnnAssign)
+                    and attr not in cm.attr_types):
+                for sub in ast.walk(stmt.annotation):
+                    if (isinstance(sub, ast.Name)
+                            and sub.id[0].isupper()
+                            and sub.id not in ("Optional", "Dict",
+                                               "List", "Tuple", "Set")):
+                        cm.attr_types[attr] = sub.id
+                        break
+
+    # -- function scan ---------------------------------------------------
+
+    def _scan_function(self, node, cls: Optional[str]) -> None:
+        qual = f"{cls}.{node.name}" if cls else node.name
+        defc = self._def_comment(node)
+        cu = parse_called_under(defc)
+        fm = FuncModel(
+            qualname=qual, line=node.lineno, cls=cls,
+            called_under=(cu,) if cu else (),
+            suppressed=parse_disables(defc))
+        params = tuple(a.arg for a in node.args.args)
+        jit = _jit_decoration(node)
+        if jit is not None and cls is None:
+            static_idx, static_names, donate_idx = jit
+            static = set(static_names)
+            static.update(params[i] for i in static_idx
+                          if i < len(params))
+            self.model.jit_funcs[node.name] = JitFunc(
+                name=node.name, params=params,
+                static_params=tuple(static),
+                donate_params=tuple(params[i] for i in donate_idx
+                                    if i < len(params)),
+                donate_idx=donate_idx, line=node.lineno)
+        scanner = _FuncScanner(
+            self, fm, self.lock_attr_names,
+            set(self.model.module_locks))
+        # Param annotations seed alias types: pipe: IngestPipeline.
+        for a in node.args.args:
+            if a.annotation is not None:
+                for sub in ast.walk(a.annotation):
+                    if (isinstance(sub, ast.Name)
+                            and sub.id[0].isupper()
+                            and sub.id not in ("Optional", "Dict",
+                                               "List", "Tuple", "Set",
+                                               "Sequence", "Callable")):
+                        scanner.aliases[a.arg] = ("paramtype", sub.id)
+                        break
+        for stmt in node.body:
+            scanner.visit(stmt)
+        if cls:
+            self.model.classes[cls].methods[node.name] = fm
+        else:
+            self.model.functions[node.name] = fm
+
+
+def collect_lock_attr_names(sources: Sequence[str]) -> Set[str]:
+    """Pre-pass over every file: the set of attribute names ever
+    assigned a Lock/RLock/Condition/RWLock — the vocabulary the
+    with-statement recognizer keys on."""
+    names: Set[str] = set()
+    for src in sources:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:  # pragma: no cover — repo always parses
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and _ctor_kind(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        names.add(t.attr)
+                    elif isinstance(t, ast.Name):
+                        names.add(t.id)
+    return names

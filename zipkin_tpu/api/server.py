@@ -123,6 +123,10 @@ class ApiServer:
         self.requests_total = self.registry.register(obs.Counter(
             "zipkin_api_requests_total", "API requests handled",
             labelnames=("route",)))
+        self._c_self_drops = self.registry.register(obs.Counter(
+            "zipkin_api_self_trace_drops_total",
+            "API self-trace span batches dropped by a failed "
+            "collector accept"))
         coal = getattr(query, "coalescer", None)
         if coal is not None:
             for attr, help_ in (
@@ -214,7 +218,9 @@ class ApiServer:
         try:
             self.collector.accept(spans)
         except Exception:
-            pass  # self-tracing must never fail a request
+            # Counted, never raised: self-tracing must not fail the
+            # request it annotates (graftlint swallowed-exception).
+            self._c_self_drops.inc()
 
     def _should_self_trace(self, method: str, path: str) -> bool:
         if self.tracer is None or not path.startswith("/api/"):

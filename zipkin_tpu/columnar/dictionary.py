@@ -28,7 +28,7 @@ class Dictionary:
     """
 
     def __init__(self, first_id: int = 0, reserved: Optional[Dict[Hashable, int]] = None):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 78 dictionary
         self._to_id: Dict[Hashable, int] = {}
         self._values: List[Hashable] = []
         self._first_id = first_id

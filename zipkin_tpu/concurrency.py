@@ -23,10 +23,10 @@ class RWLock:
     """Writer-preference readers/writer lock (non-reentrant)."""
 
     def __init__(self) -> None:
-        self._cond = threading.Condition()
-        self._readers = 0
-        self._writers_waiting = 0
-        self._writer = False
+        self._cond = threading.Condition()  # lock-order: 42 rwlock-internal
+        self._readers = 0  # guarded-by: _cond
+        self._writers_waiting = 0  # guarded-by: _cond
+        self._writer = False  # guarded-by: _cond
 
     @contextlib.contextmanager
     def read(self):

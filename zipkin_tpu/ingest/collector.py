@@ -111,11 +111,11 @@ class Collector:
         reg.register(obs.Counter(
             "zipkin_sampler_allowed_total",
             "Trace-id sampler decisions that kept the span",
-            fn=lambda: self.sampler.allowed))
+            fn=lambda: self.sampler.snapshot()[0]))
         reg.register(obs.Counter(
             "zipkin_sampler_denied_total",
             "Trace-id sampler decisions that dropped the span",
-            fn=lambda: self.sampler.denied))
+            fn=lambda: self.sampler.snapshot()[1]))
         # Ingest-step self-tracing (SURVEY §5): transport writes DIRECT
         # to the store — never through accept()/the queue — so a
         # self-trace span can't generate another self-trace span.
@@ -124,8 +124,14 @@ class Collector:
         # ITEM would double ingest dispatches and pollute the store's
         # own launch metrics with 1-span steps.
         self.tracer = None
-        self._self_buf = []
-        self._self_lock = threading.Lock()
+        self._self_buf = []  # guarded-by: _self_lock
+        self._self_lock = threading.Lock()  # lock-order: 79 self-trace
+        # Self-trace batches dropped because the store write failed —
+        # self-tracing must never fail ingest, but a silent drop hid
+        # every such failure (graftlint swallowed-exception).
+        self._c_self_drops = reg.register(obs.Counter(
+            "zipkin_collector_self_trace_drops_total",
+            "Self-trace span batches dropped by a failed store write"))
         if self_trace:
             from zipkin_tpu.client import Tracer
 
@@ -234,7 +240,9 @@ class Collector:
         try:
             self.store.apply(batch)
         except Exception:
-            pass  # self-tracing must never fail an ingest step
+            # Counted, never raised: self-tracing must not fail the
+            # ingest step it annotates.
+            self._c_self_drops.inc()
 
     def _flush_self_spans(self) -> None:
         with self._self_lock:
@@ -243,7 +251,7 @@ class Collector:
             try:
                 self.store.apply(batch)
             except Exception:
-                pass
+                self._c_self_drops.inc()  # see _self_transport
 
     def _write(self, item) -> None:
         """Queue worker entry: time the step, process, self-trace."""

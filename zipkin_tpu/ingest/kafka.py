@@ -222,7 +222,7 @@ class KafkaSpanSink:
                       "bytes_raw": 0, "bytes_wire": 0}
         # Async producers report delivery on their returned future from
         # an IO thread; counters need the lock either way.
-        self._stats_lock = threading.Lock()
+        self._stats_lock = threading.Lock()  # lock-order: 82 kafka-stats
 
     def _count(self, key: str, n: int) -> None:
         with self._stats_lock:
@@ -321,8 +321,8 @@ def connect_kafka_python(
         for c in consumers:
             try:
                 c.close()
-            except Exception:
-                pass
+            except Exception:  # graftlint: disable=swallowed-exception
+                pass  # best-effort cleanup; the original error re-raises
         raise
     receiver = KafkaSpanReceiver(
         process=process,

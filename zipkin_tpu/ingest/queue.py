@@ -41,8 +41,8 @@ class ItemQueue(Generic[T]):
         self._on_error = on_error
         self._q: "queue.Queue[T]" = queue.Queue(maxsize=max_size)
         self._closed = threading.Event()
-        self._active = 0
-        self._active_lock = threading.Lock()
+        self._active = 0  # guarded-by: _active_lock
+        self._active_lock = threading.Lock()  # lock-order: 81 queue-active
         reg = registry or obs.default_registry()
         self._c_enqueued = reg.register(obs.Counter(
             "zipkin_queue_enqueued_total",
@@ -63,7 +63,7 @@ class ItemQueue(Generic[T]):
         reg.register(obs.Gauge(
             "zipkin_queue_active_workers",
             "Workers currently processing an item",
-            fn=lambda: self._active))
+            fn=lambda: self.active_workers))
         self._workers: List[threading.Thread] = [
             threading.Thread(target=self._loop, name=f"item-queue-{i}",
                              daemon=True)
@@ -80,7 +80,8 @@ class ItemQueue(Generic[T]):
 
     @property
     def active_workers(self) -> int:
-        return self._active
+        with self._active_lock:
+            return self._active
 
     @property
     def processed(self) -> int:

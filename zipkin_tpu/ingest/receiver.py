@@ -56,7 +56,7 @@ class ScribeReceiver:
         self.categories = {c.lower() for c in categories}
         # Bumped from every API handler thread; unlocked += would lose
         # increments under concurrent Log() calls.
-        self._stats_lock = threading.Lock()
+        self._stats_lock = threading.Lock()  # lock-order: 82 receiver-stats
         self.stats: Dict[str, int] = {
             "received": 0, "ignored": 0, "bad": 0, "pushed_back": 0,
         }

@@ -354,7 +354,11 @@ def main(argv=None) -> None:
         try:
             collector.flush()
         except Exception:
-            pass  # a failed drain must not block the checkpoint
+            # A failed drain must not block the checkpoint — but it
+            # must be SEEN (graftlint swallowed-exception).
+            import traceback
+
+            traceback.print_exc()
         try:
             checkpoint_now()
         except Exception:

@@ -42,7 +42,7 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "native", "span_codec.cc")
 _SO = os.path.join(os.path.dirname(_SRC), "libzipkin_native.so")
 
-_lock = threading.Lock()
+_lock = threading.Lock()  # lock-order: 86 native-build
 _lib = None
 
 

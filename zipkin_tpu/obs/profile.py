@@ -16,7 +16,7 @@ from typing import Optional
 
 MAX_SECONDS = 120.0
 
-_capture_lock = threading.Lock()
+_capture_lock = threading.Lock()  # lock-order: 86 profiler
 
 
 class ProfilerBusy(RuntimeError):

@@ -82,8 +82,8 @@ class Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
-        self._children: Dict[Tuple[str, ...], "Metric"] = {}
+        self._lock = threading.Lock()  # lock-order: 90 metric (leaf)
+        self._children: Dict[Tuple[str, ...], "Metric"] = {}  # guarded-by: _lock
 
     def labels(self, **kv) -> "Metric":
         if tuple(sorted(kv)) != tuple(sorted(self.labelnames)):
@@ -190,8 +190,8 @@ class Gauge(Metric):
         if fn is not None:
             try:
                 return fn()
-            except Exception:
-                return float("nan")
+            except Exception:  # graftlint: disable=swallowed-exception
+                return float("nan")  # NaN IS the broken-callback signal
         with self._lock:
             return self._value
 
@@ -216,8 +216,8 @@ class CallbackFamily(Metric):
     def samples(self):
         try:
             values = self._fn()
-        except Exception:
-            return
+        except Exception:  # graftlint: disable=swallowed-exception
+            return  # absent family = the broken-callback signal
         label = self.labelnames[0]
         for k in sorted(values):
             yield "", ((label, str(k)),), values[k]
@@ -332,8 +332,11 @@ class Registry:
     """Name → metric map with replace-on-reregister semantics."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._metrics: Dict[str, Metric] = {}
+        # Held only for map mutation/snapshot — samples() and gauge
+        # callbacks run OUTSIDE it (collect() snapshots), so this is a
+        # leaf despite exposition fanning out into other locks.
+        self._lock = threading.Lock()  # lock-order: 84 registry
+        self._metrics: Dict[str, Metric] = {}  # guarded-by: _lock
 
     def register(self, metric: Metric) -> Metric:
         with self._lock:

@@ -11,7 +11,7 @@ the sampler/query layer needs crosses shards via ICI collectives only.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -162,6 +162,13 @@ def make_sharded_ingest(mesh: Mesh, axis: str = "shard"):
     return jax.jit(mapped, donate_argnums=(0,))
 
 
+def stacked_incoming(device_batches) -> int:
+    """Max spans any shard's batch carries, read off the stacked
+    pytree. SYNCS when the stack is device-resident — call it OUTSIDE
+    store locks and pass the result to ``ShardedStore.ingest``."""
+    return int(np.max(np.asarray(device_batches.n_spans)))
+
+
 class ShardedStore:
     """Host handle for an n-shard device store.
 
@@ -191,9 +198,23 @@ class ShardedStore:
     # cross-batch child waits for its link in per-ingest summaries.
     SWEEP_EVERY = 64
 
-    def ingest(self, device_batches) -> Dict[str, np.ndarray]:
-        """device_batches: pytree stacked [n_shards, ...]."""
-        incoming = int(np.max(np.asarray(device_batches.n_spans)))
+    def ingest(self, device_batches,
+               incoming: Optional[int] = None) -> Dict[str, np.ndarray]:
+        """device_batches: pytree stacked [n_shards, ...].
+
+        ``incoming`` is the max spans any shard's batch carries —
+        compute it HOST-SIDE (or via ``stacked_incoming`` outside any
+        store lock) and pass it in. It is required: reading it off the
+        device-resident stack here would put a host sync inside every
+        caller's lock hold (ShardedSpanStore._apply_locked commits
+        under the write lock — graftlint sync-under-lock, the r10
+        group-commit stall class)."""
+        if incoming is None:
+            raise TypeError(
+                "ShardedStore.ingest requires incoming= (max spans "
+                "per shard batch); use stacked_incoming(batches) "
+                "OUTSIDE store locks")
+        incoming = int(incoming)
         self._maybe_archive(incoming)
         self._batches_since_sweep += 1
         if self._batches_since_sweep >= self.SWEEP_EVERY:
@@ -283,12 +304,19 @@ class ShardedSpanStore(SuspectGuard):
         self.ttls: Dict[int, float] = {}
         self.pins = PinBank()
         self._name_lc: Dict[int, int] = {}
-        self._kernels: Dict = {}
+        self._kernels: Dict = {}  # guarded-by: _kernels_lock
         # Same discipline as TpuSpanStore: _lock serializes writers and
         # host dicts; the RWLock guards the states swap (sharded ingest
         # donates the previous stacked states) against in-flight reads.
-        self._lock = threading.Lock()
-        self._rw = RWLock()
+        # _kernels_lock is a dedicated LEAF for the mapped-kernel
+        # compile cache: query threads build kernels while HOLDING the
+        # read lock, so guarding the dict with _lock would invert the
+        # encode(10) -> commit(40) order (a writer holding _lock and
+        # waiting on the write lock deadlocks against a reader waiting
+        # on _lock — graftlint lock-order forbids the shortcut).
+        self._lock = threading.Lock()  # lock-order: 10 encode
+        self._rw = RWLock()  # lock-order: 40 commit
+        self._kernels_lock = threading.Lock()  # lock-order: 75 kernel-cache
 
     @property
     def dicts(self):
@@ -398,8 +426,13 @@ class ShardedSpanStore(SuspectGuard):
         stacked = jax.device_put(
             stack_batches(dbs), NamedSharding(self.mesh, P(self.axis))
         )
+        # incoming from the HOST batches: reading it off the stacked
+        # device pytree inside the write-lock hold was a device sync
+        # stalling every reader behind the commit (graftlint
+        # sync-under-lock, the r10 group-commit stall class).
+        incoming = max(b.n_spans for b in batches)
         with self._rw.write():
-            self.inner.ingest(stacked)
+            self.inner.ingest(stacked, incoming=incoming)
 
     DEFAULT_TTL_S = 1.0
 
@@ -426,10 +459,17 @@ class ShardedSpanStore(SuspectGuard):
     # -- mapped query kernels (cached per static shape) ------------------
 
     def _kernel(self, key, build):
-        fn = self._kernels.get(key)
+        # The cache dict is shared by every API handler thread
+        # (graftlint guarded-by caught the old unlocked check-then-
+        # set). build() traces OUTSIDE the hold: tracing can take
+        # seconds and needs no cache state — a duplicate build for a
+        # racing key is cheap, a lock held across jax tracing is not.
+        with self._kernels_lock:
+            fn = self._kernels.get(key)
         if fn is None:
             fn = build()
-            self._kernels[key] = fn
+            with self._kernels_lock:
+                fn = self._kernels.setdefault(key, fn)
         return fn
 
     def _unstack(self, state):

@@ -74,10 +74,10 @@ class ResidentCoalescer:
         self.store = store
         self.window_s = window_s
         self._dispatch_timer = dispatch_timer
-        self._cv = threading.Condition()
-        self._pending: List[_Slot] = []
-        self._inflight = 0  # slots in the batch currently executing
-        self._closed = False
+        self._cv = threading.Condition()  # lock-order: 15 coalesce
+        self._pending: List[_Slot] = []  # guarded-by: _cv
+        self._inflight = 0  # executing slots; guarded-by: _cv
+        self._closed = False  # guarded-by: _cv
         self.batches = 0
         self.queries = 0
         self.launches_saved = 0
@@ -212,7 +212,8 @@ class ResidentCoalescer:
 
     @property
     def closed(self) -> bool:
-        return self._closed
+        with self._cv:
+            return self._closed
 
 
 class QueryCoalescer:
@@ -230,9 +231,9 @@ class QueryCoalescer:
     def __init__(self, store, window_s: float = 0.002, registry=None):
         self.store = store
         self.window_s = window_s
-        self._cv = threading.Condition()
-        self._pending: List[_Slot] = []
-        self._leader_active = False
+        self._cv = threading.Condition()  # lock-order: 15 coalesce
+        self._pending: List[_Slot] = []  # guarded-by: _cv
+        self._leader_active = False  # guarded-by: _cv
         # Observability (surfaced via /metrics): launches_saved is the
         # number of device dispatches coalescing removed vs one-call-
         # per-request; the sketch is the full batch-size distribution

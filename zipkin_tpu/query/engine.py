@@ -90,8 +90,8 @@ class _ResultCache:
 
     def __init__(self, entries: int = 1024):
         self.entries = entries
-        self._lock = threading.Lock()
-        self._map: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()  # lock-order: 70 result-cache
+        self._map: "OrderedDict" = OrderedDict()  # guarded-by: _lock
 
     def get(self, key):
         with self._lock:

@@ -52,12 +52,12 @@ class Sampler:
 
     def __init__(self, rate: float = 1.0):
         self.rate = rate
-        self.allowed = 0
-        self.denied = 0
+        self.allowed = 0  # guarded-by: lock
+        self.denied = 0  # guarded-by: lock
         # Counters are bumped from every collector worker thread; an
         # unlocked read-modify-write loses increments under concurrency
         # and skews the adaptive controller's inputs.
-        self.lock = threading.Lock()
+        self.lock = threading.Lock()  # lock-order: 80 sampler
 
     @property
     def threshold(self) -> int:
@@ -68,6 +68,13 @@ class Sampler:
         with self.lock:
             self.allowed += allowed
             self.denied += denied
+
+    def snapshot(self):
+        """(allowed, denied) under the lock — the metrics read path
+        (the collector's gauges read these from the exposition thread
+        while workers bump them; graftlint guarded-by)."""
+        with self.lock:
+            return self.allowed, self.denied
 
     def decide(self, trace_id: int) -> bool:
         """Pure threshold test, no counters, no lock — batch callers

@@ -70,9 +70,9 @@ class SegmentDirectory:
 
         self.params = params
         self.codec = codec
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 55 archive-dir
         self._segments: List[Segment] = []
-        self._next_id = 0
+        self._next_id = 0  # guarded-by: _lock
         self._decoded: Dict[int, tuple] = {}
         self._compactor: Optional[threading.Thread] = None
         self._compactor_stop = threading.Event()

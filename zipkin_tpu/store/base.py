@@ -449,7 +449,7 @@ class StoreSuspectError(RuntimeError):
     joined (ADVICE r5 checkpoint hazard)."""
 
 
-_SUSPECT_LOCK = threading.Lock()
+_SUSPECT_LOCK = threading.Lock()  # lock-order: 83 suspect-flag
 
 
 class SuspectGuard:

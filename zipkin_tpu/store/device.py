@@ -1477,7 +1477,7 @@ def _fifo_ranks_counting(bucket, valid, n_buckets: int, block: int):
 # path choices they record: a new store reusing a config also reuses
 # those compiled steps, so the inherited record is accurate for it.
 _ACTIVE_PATHS: Dict[StoreConfig, Dict[str, set]] = {}
-_ACTIVE_PATHS_LOCK = threading.Lock()
+_ACTIVE_PATHS_LOCK = threading.Lock()  # lock-order: 85 trace-registry
 
 
 def _note_path(config: StoreConfig, kind: str, value: str) -> None:
@@ -3500,8 +3500,8 @@ def compile_count() -> int:
     for fn in _INGEST_JITS:
         try:
             total += fn._cache_size()
-        except Exception:  # pragma: no cover — jax internals moved
-            pass
+        except Exception:  # pragma: no cover; graftlint: disable=swallowed-exception
+            pass  # best-effort probe of a private jax API
     return total
 
 
@@ -3516,6 +3516,6 @@ def query_compile_count() -> int:
     for fn in _QUERY_JITS:
         try:
             total += fn._cache_size()
-        except Exception:  # pragma: no cover — jax internals moved
-            pass
+        except Exception:  # pragma: no cover; graftlint: disable=swallowed-exception
+            pass  # best-effort probe of a private jax API
     return total

@@ -87,7 +87,7 @@ def match_spans_by_annotation(spans, service_name: str, annotation: str,
 
 class InMemorySpanStore(SpanStore):
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 10 encode
         self.spans: List[Span] = []
         self.ttls: Dict[int, float] = {}
         # Windowed-analytics time-bucket width (s) for the exact-scan

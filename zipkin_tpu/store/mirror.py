@@ -79,16 +79,16 @@ class SketchMirror:
         self.config = config
         c = config
         self.gamma = (1.0 + c.quantile_alpha) / (1.0 - c.quantile_alpha)
-        self._lock = threading.Lock()
-        self._warm = True  # a fresh store's aggregates are all zero
+        self._lock = threading.Lock()  # lock-order: 50 mirror
+        self._warm = True  # a fresh store's zeros are warm; guarded-by: _lock
         S = c.max_services
-        self.svc_hist = np.zeros((S, c.quantile_buckets), np.int32)
-        self.ann_svc_counts = np.zeros(S, np.int32)
-        self.name_presence = np.zeros((S, c.max_span_names), np.int32)
+        self.svc_hist = np.zeros((S, c.quantile_buckets), np.int32)  # guarded-by: _lock
+        self.ann_svc_counts = np.zeros(S, np.int32)  # guarded-by: _lock
+        self.name_presence = np.zeros((S, c.max_span_names), np.int32)  # guarded-by: _lock
         self.ann_value_counts = np.zeros(
-            (S, c.max_annotation_values), np.int32)
-        self.bann_key_counts = np.zeros((S, c.max_binary_keys), np.int32)
-        self.hll_traces = np.zeros(1 << c.hll_p, np.int32)
+            (S, c.max_annotation_values), np.int32)  # guarded-by: _lock
+        self.bann_key_counts = np.zeros((S, c.max_binary_keys), np.int32)  # guarded-by: _lock
+        self.hll_traces = np.zeros(1 << c.hll_p, np.int32)  # guarded-by: _lock
         # Windowed Moments-sketch arena twins (aggregate/windows.py):
         # same dtypes/fills as the device arrays, folded by the same
         # integer adds/maxes → bitwise-equal cells. ``dicts`` resolves
@@ -96,11 +96,11 @@ class SketchMirror:
         # (None = no dictionary ⇒ no error detection).
         self.dicts = dicts
         Wn = c.win_slots
-        self.win_epoch = np.full(Wn, -1, np.int64)
-        self.win_counts = np.zeros((S, Wn, win.N_COUNT_FIELDS), np.int32)
-        self.win_sums = np.zeros((S, Wn, win.N_SUM_FIELDS), np.int64)
+        self.win_epoch = np.full(Wn, -1, np.int64)  # guarded-by: _lock
+        self.win_counts = np.zeros((S, Wn, win.N_COUNT_FIELDS), np.int32)  # guarded-by: _lock
+        self.win_sums = np.zeros((S, Wn, win.N_SUM_FIELDS), np.int64)  # guarded-by: _lock
         self.win_mm = np.full((S, Wn, win.N_MM_FIELDS), win.I32_MIN,
-                              np.int32)
+                              np.int32)  # guarded-by: _lock
         # Process-lifetime monotonic fold counters (the
         # zipkin_window_* Prometheus families): unaffected by ring
         # self-clears or adoption resyncs, so scrapes never regress.
@@ -111,7 +111,8 @@ class SketchMirror:
 
     @property
     def warm(self) -> bool:
-        return self._warm
+        with self._lock:
+            return self._warm
 
     def mark_cold(self) -> None:
         """The device state was swapped without a delta (checkpoint
@@ -167,9 +168,13 @@ class SketchMirror:
             tid = np.asarray(b.trace_id, np.int64)
             if tid.size:
                 hi, lo = split64(tid)
+                # Register-count mask from CONFIG, not the live array:
+                # delta_of is stage 1's lock-free pure function, and
+                # reading a _lock-guarded array here (even just .size)
+                # would break that contract (graftlint guarded-by).
                 hll_i_parts.append(
                     (np_hash2_32(hi, lo, 101)
-                     & _U32(self.hll_traces.size - 1)).astype(np.int64))
+                     & _U32((1 << c.hll_p) - 1)).astype(np.int64))
                 hll_r_parts.append(
                     (np_clz32(np_hash2_32(hi, lo, 202)) + 1).astype(
                         np.int32))
@@ -234,7 +239,7 @@ class SketchMirror:
             for batch, _, _ in group
         )
 
-    def apply(self, delta: SketchDelta) -> None:
+    def apply(self, delta: SketchDelta) -> None:  # called-under: _rw.write
         """Fold one unit's delta in — called from the commit stage
         INSIDE the store's write-lock hold, immediately before the
         frontier bump, so sketch-tier reads at frontier F always

@@ -98,15 +98,18 @@ class _StageBase:
     and blocked producers always terminate."""
 
     def __init__(self):
-        self._cond = threading.Condition()
-        self._fed = 0
-        self._done = 0
-        self._error: Optional[BaseException] = None
-        self._closed = False
+        self._cond = threading.Condition()  # lock-order: 65 stage
+        self._fed = 0  # guarded-by: _cond
+        self._done = 0  # guarded-by: _cond
+        self._error: Optional[BaseException] = None  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
 
     @property
     def error(self) -> Optional[BaseException]:
-        return self._error
+        """Peek at the parked worker error without clearing it
+        (TpuSpanStore.stop_pipeline re-raises it after stop)."""
+        with self._cond:
+            return self._error
 
     def take_error(self) -> Optional[BaseException]:
         """Pop the parked worker error (if any). Surfacing CLEARS it —

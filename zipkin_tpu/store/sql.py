@@ -112,8 +112,8 @@ CREATE TABLE IF NOT EXISTS dependency_links (
 
 class SqliteSpanStore(SpanStore):
     def __init__(self, path: str = ":memory:"):
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)  # guarded-by: _lock
+        self._lock = threading.Lock()  # lock-order: 10 encode
         with self._lock:
             self._conn.executescript(_DDL)
             self._conn.commit()
@@ -121,7 +121,7 @@ class SqliteSpanStore(SpanStore):
             # would scan the whole table under the lock on every control
             # tick. Seeded from the table so reopened stores keep counting.
             row = self._conn.execute("SELECT COUNT(*) FROM spans").fetchone()
-            self._stored = int(row[0])
+            self._stored = int(row[0])  # guarded-by: _lock
 
     def close(self) -> None:
         with self._lock:

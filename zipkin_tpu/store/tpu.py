@@ -20,7 +20,15 @@ from __future__ import annotations
 import contextlib
 import threading
 import time as _time
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import (
+    TYPE_CHECKING,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +43,9 @@ from zipkin_tpu.models.span import Span
 from zipkin_tpu.ops import hll
 from zipkin_tpu.ops import quantile as Q
 from zipkin_tpu.store import device as dev
+
+if TYPE_CHECKING:  # typing only — also feeds graftlint's call resolver
+    from zipkin_tpu.wal.log import WriteAheadLog
 from zipkin_tpu.store.pipeline import (
     EvictionSealer,
     IngestPipeline,
@@ -310,12 +321,12 @@ class TpuSpanStore(SpanStore):
         self.codec = codec or SpanCodec()
         self.state = dev.init_state(self.config)
         # Serializes writers against each other (queue workers).
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 10 encode
         # Guards the state swap: ingest_step donates the old state's
         # device buffers, so queries snapshot self.state under a read
         # lock and hold it across their kernels + host gathers, while
         # the donating step runs under the write lock (ADVICE r1 high).
-        self._rw = RWLock()
+        self._rw = RWLock()  # lock-order: 40 commit
         # Host mirrors of write_pos / last-bucket-close position, pacing
         # the dependency bucket rotation without a device sync per batch.
         self._wp = 0
@@ -332,9 +343,9 @@ class TpuSpanStore(SpanStore):
         self.eviction_sink = None
         self._awp = 0
         self._bwp = 0
-        self._cap_upto = 0
-        self._cap_a = 0
-        self._cap_b = 0
+        self._cap_upto = 0  # guarded-by: _cap_lock
+        self._cap_a = 0  # guarded-by: _cap_lock
+        self._cap_b = 0  # guarded-by: _cap_lock
         # Async eviction sealing (store/pipeline.EvictionSealer): with
         # capture_backlog > 0 the write path only PULLS a capture
         # window (read-only launch, ordering invariant intact) and a
@@ -345,8 +356,8 @@ class TpuSpanStore(SpanStore):
         # path (under _lock) and the pipeline's commit thread.
         self.capture_backlog = self.CAPTURE_BACKLOG
         self._sealer: Optional[EvictionSealer] = None
-        self._sealed_upto = 0
-        self._cap_lock = threading.Lock()
+        self._sealed_upto = 0  # guarded-by: _cap_lock
+        self._cap_lock = threading.Lock()  # lock-order: 30 capture
         # Pipelined ingest (store/pipeline.IngestPipeline), opt-in via
         # start_pipeline(): apply/write_thrift become stage 1 (encode +
         # pad under _lock) and the commit thread owns the device write
@@ -361,7 +372,7 @@ class TpuSpanStore(SpanStore):
         # cuts read a sequence exactly consistent with the state), and
         # _wal_marks the dictionary high-water sizes of the last
         # journaled record (the next record's delta base).
-        self.wal = None
+        self.wal: Optional[WriteAheadLog] = None
         self._wal_applied = 0
         self._wal_marks = None
         # Host sketch mirror (store/mirror.SketchMirror): numpy twins
@@ -1042,14 +1053,20 @@ class TpuSpanStore(SpanStore):
         if sink is None:
             return
         c = self.config
-        if (self._wp + n_s - self._cap_upto <= c.capacity
-                and self._awp + n_a - self._cap_a <= c.ann_capacity
-                and self._bwp + n_b - self._cap_b <= c.bann_capacity):
-            return
+        # Threshold check UNDER the capture lock: the clocks it reads
+        # are _cap_lock-guarded, and the committing thread is the only
+        # writer, so the uncontended acquire costs nothing while
+        # keeping the read inside the lock's ownership (graftlint
+        # guarded-by; the old lock-free early-out raced capture_now).
         with self._cap_lock:
+            if (self._wp + n_s - self._cap_upto <= c.capacity
+                    and self._awp + n_a - self._cap_a <= c.ann_capacity
+                    and self._bwp + n_b - self._cap_b
+                    <= c.bann_capacity):
+                return
             self._capture_window()
 
-    def _capture_window(self) -> None:
+    def _capture_window(self) -> None:  # called-under: _cap_lock
         """Pull the whole uncaptured window [cap_upto, wp) — the ONE
         capture body behind the write-path trigger and capture_now,
         serialized by _cap_lock (the serial writer holds self._lock
@@ -1089,7 +1106,7 @@ class TpuSpanStore(SpanStore):
             kill_point("mid-seal")
             self.eviction_sink(batch, gids, lo, hi,
                                _time.perf_counter() - t0)
-            self._note_sealed(lo, hi)
+            self._note_sealed_locked(lo, hi)
         # Clocks advance only AFTER the pull succeeds: a transient
         # device error mid-pull leaves the window uncaptured-but-
         # resident, and the next write retries it — stamping first
@@ -1103,14 +1120,36 @@ class TpuSpanStore(SpanStore):
 
     def _note_sealed(self, lo: int, hi: int) -> None:
         """Advance the sealed frontier — every gid below it is durable
-        in the cold tier (called by the inline seal path and the
-        sealer thread). CONTIGUITY-GATED: if an earlier window's seal
-        failed (a hole — its rows are lost from the cold tier), the
-        frontier stays below the hole even as later windows seal, so a
-        checkpoint cut never claims the hole and a restore can
-        re-capture whatever of it the saved rings still held."""
+        in the cold tier (called by the SEALER THREAD; the inline seal
+        path, already under _cap_lock, uses the _locked twin).
+        CONTIGUITY-GATED: if an earlier window's seal failed (a hole —
+        its rows are lost from the cold tier), the frontier stays
+        below the hole even as later windows seal, so a checkpoint cut
+        never claims the hole and a restore can re-capture whatever of
+        it the saved rings still held.
+
+        The _cap_lock hold is load-bearing: the sealer thread races
+        the commit thread's capture trigger and checkpoint's frontier
+        cut, and an unlocked read-modify-write here could publish a
+        torn frontier (graftlint guarded-by caught the old unlocked
+        version)."""
+        with self._cap_lock:
+            self._note_sealed_locked(lo, hi)
+
+    def _note_sealed_locked(self, lo: int, hi: int) -> None:  # called-under: _cap_lock
         if lo <= self._sealed_upto:
             self._sealed_upto = max(self._sealed_upto, hi)
+
+    def sealed_frontier(self) -> int:
+        """Cold-tier durability frontier (gid): every span below it is
+        sealed into a cold segment. The sanctioned read for callers
+        holding NO store lock (operator tooling, tests). NOT for code
+        already under ``_rw`` — taking ``_cap_lock`` inside a read/
+        write hold inverts the canonical capture(30) → commit(40)
+        order; checkpoint's save path documents its deliberately
+        unlocked reads for exactly that reason."""
+        with self._cap_lock:
+            return self._sealed_upto
 
     def seal_barrier(self) -> None:
         """Wait until every pulled capture window is sealed (no-op
@@ -1187,11 +1226,12 @@ class TpuSpanStore(SpanStore):
         # The adopted state's history predates the sink: re-seed the
         # capture clocks so only post-adoption evictions are captured.
         # The sealed frontier follows (nothing is pending: the barrier
-        # above drained the sealer).
+        # above drained the sealer; the lock still owns the clocks).
         self._awp = self._bwp = 0
-        self._cap_upto = self._wp
-        self._cap_a = self._cap_b = 0
-        self._sealed_upto = self._cap_upto
+        with self._cap_lock:
+            self._cap_upto = self._wp
+            self._cap_a = self._cap_b = 0
+            self._sealed_upto = self._cap_upto
         # The adopted state's aggregates were built outside the write
         # path: resync the sketch mirror lazily from the device.
         self.sketch_mirror.mark_cold()

@@ -172,7 +172,7 @@ class _PartitionLog:
 
     def __init__(self):
         self.values: List[bytes] = []  # raw message bytes (crc..value)
-        self.lock = threading.Lock()
+        self.lock = threading.Lock()  # lock-order: 89 fake-partition
 
     def append(self, msgs: List[bytes]) -> int:
         with self.lock:
@@ -226,7 +226,7 @@ class FakeKafkaBroker:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.topics: Dict[str, _PartitionLog] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # lock-order: 87 fake-broker
         self.stats = {"produce": 0, "fetch": 0, "metadata": 0,
                       "corrupt_rejected": 0}
         self._server = _Server((host, port), _BrokerHandler)
@@ -351,8 +351,8 @@ class _Conn:
     def __init__(self, host: str, port: int, client_id: str):
         self.sock = socket.create_connection((host, port), timeout=10)
         self.client_id = client_id
-        self._corr = 0
-        self._lock = threading.Lock()
+        self._corr = 0  # guarded-by: _lock
+        self._lock = threading.Lock()  # lock-order: 88 fake-conn
 
     def request(self, api_key: int, body: bytes,
                 expect_response: bool = True) -> Optional[_Reader]:

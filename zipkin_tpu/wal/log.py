@@ -229,22 +229,24 @@ class WriteAheadLog:
         self.interval_s = max(1e-3, float(interval_s))
         self.segment_bytes = max(1 << 12, int(segment_bytes))
         self.compress = compress
-        self._cond = threading.Condition()
-        self._segments: List[_Segment] = []
-        self._file = None
-        self._closed = False
+        self._cond = threading.Condition()  # lock-order: 60 wal
+        self._segments: List[_Segment] = []  # guarded-by: _cond
+        self._file = None  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
         # Set when a failed append leaves bytes we could not truncate
         # away (every later append would sit past a torn frame and be
         # silently cut at recovery — refuse instead).
-        self._poisoned: Optional[BaseException] = None
+        self._poisoned: Optional[BaseException] = None  # guarded-by: _cond
         # Last group-commit fsync failure (cleared by the next success);
         # wait_durable surfaces it instead of timing out silently.
         # _sync_fails counts failures monotonically, so waiters can
         # distinguish "still failing" (a FRESH failure landed while
         # they waited) from "stale error, retry thread merely starved".
-        self._sync_error: Optional[BaseException] = None
-        self._sync_fails = 0
+        self._sync_error: Optional[BaseException] = None  # guarded-by: _cond
+        self._sync_fails = 0  # guarded-by: _cond
         self.torn_records_cut = 0  # records dropped by the open() scan
+        self._next_seq = 1  # guarded-by: _cond
+        self._durable = 0  # guarded-by: _cond
         self._open_scan()
         reg = registry or obs.default_registry()
         self._registry = reg
@@ -259,11 +261,11 @@ class WriteAheadLog:
         self.g_bytes = reg.register(obs.Gauge(
             "zipkin_wal_segment_bytes",
             "Live WAL bytes on disk across all segments",
-            fn=lambda: float(sum(s.nbytes for s in self._segments))))
+            fn=self._live_bytes))
         self.g_backlog = reg.register(obs.Gauge(
             "zipkin_wal_truncation_backlog_segments",
             "Segment files not yet covered by a checkpoint truncation",
-            fn=lambda: float(len(self._segments))))
+            fn=self._live_segments))
         self.c_records = reg.register(obs.Counter(
             "zipkin_wal_records_total", "Records appended to the WAL"))
         self.c_replayed = reg.register(obs.Counter(
@@ -284,8 +286,20 @@ class WriteAheadLog:
                 daemon=True)
             self._syncer.start()
 
+    def _live_bytes(self) -> float:
+        """Gauge callback (exposition thread): the _segments list is
+        _cond-guarded, so snapshot under it — the old lock-free lambda
+        raced truncate()'s list swap (graftlint guarded-by)."""
+        with self._cond:
+            return float(sum(s.nbytes for s in self._segments))
+
+    def _live_segments(self) -> float:
+        with self._cond:
+            return float(len(self._segments))
+
     # -- open-time scan -------------------------------------------------
 
+    # graftlint: disable=guarded-by — __init__-time, pre-thread
     def _open_scan(self) -> None:
         """Adopt the valid prefix of an existing directory: scan every
         segment in base_seq order, truncate the first torn/corrupt one
@@ -346,7 +360,7 @@ class WriteAheadLog:
 
     # -- append path ----------------------------------------------------
 
-    def _ensure_file_locked(self):
+    def _ensure_file_locked(self):  # called-under: _cond
         if self._file is None:
             if not self._segments:
                 self._roll_locked()
@@ -356,7 +370,7 @@ class WriteAheadLog:
             self._roll_locked()
         return self._file
 
-    def _roll_locked(self) -> None:
+    def _roll_locked(self) -> None:  # called-under: _cond
         if self._file is not None:
             self._file.flush()
             os.fsync(self._file.fileno())
@@ -426,7 +440,7 @@ class WriteAheadLog:
         self.c_records.inc()
         return seq
 
-    def _fsync_locked(self) -> None:
+    def _fsync_locked(self) -> None:  # called-under: _cond
         if self._file is not None:
             t0 = time.perf_counter()
             os.fsync(self._file.fileno())
@@ -545,7 +559,9 @@ class WriteAheadLog:
         this sees only CRC-valid frames; a record that rots BETWEEN
         open and replay still stops the iteration at the last valid
         prefix (counted corrupt) rather than raising."""
-        for seg in list(self._segments):
+        with self._cond:
+            segments = list(self._segments)
+        for seg in segments:
             if seg.last_seq <= from_seq:
                 continue
             n_seen = 0
