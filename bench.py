@@ -1287,6 +1287,191 @@ def bench_windows(total_spans: int = 200_000):
     return out
 
 
+def bench_replication(total_spans: int = 100_000, n_replicas: int = 3):
+    """Replication phase (r15 tentpole, zipkin_tpu.replicate): what
+    WAL shipping buys and costs. One WAL-attached tiered primary
+    streams while (a) N device-free replicas and (b) one warm standby
+    follow over the real framed-TCP ship path. Measures: replica
+    staleness lag under full ingest load (records and seconds),
+    failover RTO (standby drains the durable tail + promotes, bitwise
+    vs the primary), aggregate sketch-tier queries/s across the
+    replica fleet (the horizontal read-scaling claim), and per-replica
+    apply rate (the ceiling on how fast a CPU can follow one chip)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from zipkin_tpu.replicate import (
+        Follower,
+        ReplicaTarget,
+        ShipClient,
+        ShipServer,
+        StandbyTarget,
+        WalShipper,
+    )
+    from zipkin_tpu.replicate.protocol import config_from_dict
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.archive import TieredSpanStore
+    from zipkin_tpu.store.replica import ReplicaSpanStore
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.testing.crash import states_bitwise_equal
+    from zipkin_tpu.tracegen import generate_traces
+    from zipkin_tpu.wal import WriteAheadLog
+
+    cap = 1 << max(12, total_spans.bit_length() - 2)
+    config = dev.StoreConfig(
+        capacity=cap, ann_capacity=4 * cap, bann_capacity=2 * cap,
+        max_services=64, max_span_names=256,
+        max_annotation_values=512, max_binary_keys=64,
+        cms_width=1 << 12, hll_p=10, quantile_buckets=512,
+    )
+    _log(f"replication phase: {total_spans} spans, {n_replicas} "
+         f"device-free replicas + 1 warm standby")
+    spans = []
+    while len(spans) < total_spans:
+        spans.extend(
+            s for t in generate_traces(
+                n_traces=max(total_spans // 5, 64), max_depth=3,
+                n_services=32,
+            ) for s in t
+        )
+    spans = spans[:total_spans]
+    chunk = 1024
+    root = tempfile.mkdtemp(prefix="replication-bench-")
+    followers = []
+    replicas = []
+    server = None
+    try:
+        primary = TieredSpanStore(TpuSpanStore(config))
+        wal = WriteAheadLog(os.path.join(root, "wal"), fsync="off")
+        primary.attach_wal(wal)
+        shipper = WalShipper(primary)
+        server = ShipServer(shipper, host="127.0.0.1", port=0)
+        port = server.server_address[1]
+        server.serve_in_thread()
+
+        for r in range(n_replicas):
+            c = ShipClient("127.0.0.1", port, f"bench-replica-{r}",
+                           mode="replica")
+            replica = ReplicaSpanStore(config_from_dict(
+                c.connect()["config"]))
+            replicas.append(replica)
+            followers.append(Follower(
+                ReplicaTarget(replica), c,
+                poll_interval_s=0.002).start())
+        sc = ShipClient("127.0.0.1", port, "bench-standby",
+                        mode="standby")
+        sc.connect()
+        standby = TpuSpanStore(config)
+        f_sby = Follower(StandbyTarget(standby), sc,
+                         poll_interval_s=0.002)
+        followers.append(f_sby.start())
+
+        # Full-load stream with lag sampling per batch.
+        lags = []
+        t0 = time.perf_counter()
+        for i in range(0, len(spans), chunk):
+            primary.apply(spans[i:i + chunk])
+            lags.append(max(f.lag_records() for f in followers))
+        ingest_s = time.perf_counter() - t0
+        wal.sync()
+        records_total = wal.last_seq
+        s_per_record = ingest_s / max(records_total, 1)
+
+        # Failover RTO: standby applies the durable tail + promotes.
+        t0 = time.perf_counter()
+        sby_ok = f_sby.drain(300.0)
+        promoted = f_sby.promote()
+        rto_s = time.perf_counter() - t0
+        standby_bitwise = states_bitwise_equal(
+            primary.hot.state, promoted.state)
+
+        t0 = time.perf_counter()
+        reps_ok = all(f.drain(300.0) for f in followers[:-1])
+        replica_catch_up_s = time.perf_counter() - t0
+
+        # Bitwise agreement at the drained frontier (replica 0 stands
+        # for the fleet: all applied the identical record stream).
+        a_p = primary.hot.ensure_sketch_mirror().arrays()
+        mirror_bitwise = all(
+            all(np.array_equal(x, y)
+                for x, y in zip(a_p, rep.sketch_mirror.arrays()))
+            for rep in replicas
+        )
+        svcs = sorted(primary.get_all_service_names())
+        agree = all(
+            rep.service_duration_quantiles(svc, [0.5, 0.99])
+            == primary.service_duration_quantiles(svc, [0.5, 0.99])
+            for rep in replicas for svc in svcs[:3]
+        )
+
+        # Aggregate replica read throughput: one thread per replica
+        # hammers the sketch tier (the dashboard-fanout shape).
+        reads_per_thread = 400
+        counts = [0] * len(replicas)
+
+        def read_loop(idx):
+            rep = replicas[idx]
+            for i in range(reads_per_thread):
+                svc = svcs[i % len(svcs)]
+                rep.service_duration_quantiles(svc, [0.5, 0.99])
+                rep.top_annotations(svc)
+                rep.estimated_unique_traces()
+                counts[idx] += 3
+
+        threads = [threading.Thread(target=read_loop, args=(i,))
+                   for i in range(len(replicas))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        fleet_s = time.perf_counter() - t0
+        fleet_qps = sum(counts) / fleet_s
+
+        lag_arr = np.asarray(lags[1:] or [0], np.int64)
+        rep0 = replicas[0]
+        return {
+            "spans": total_spans,
+            "replicas": n_replicas,
+            "records_shipped": int(records_total),
+            "primary_ingest_spans_per_s": round(
+                total_spans / ingest_s, 1),
+            "lag_records_max": int(lag_arr.max()),
+            "lag_records_p50": int(np.median(lag_arr)),
+            "lag_seconds_max": round(
+                float(lag_arr.max()) * s_per_record, 3),
+            "replica_catch_up_s": round(replica_catch_up_s, 3),
+            "replica_apply_spans_per_s": round(
+                rep0.spans_applied
+                / max(ingest_s + replica_catch_up_s, 1e-9), 1),
+            "failover_rto_s": round(max(rto_s, 1e-4), 4),
+            "standby_bitwise": bool(standby_bitwise),
+            "standby_caught_up": bool(sby_ok),
+            "replicas_caught_up": bool(reps_ok),
+            "mirror_bitwise_all_replicas": bool(mirror_bitwise),
+            "sketch_answers_identical": bool(agree),
+            "fleet_sketch_queries_per_s": round(fleet_qps, 1),
+            "fleet_read_threads": len(replicas),
+            "shipped_mb_per_follower": round(
+                shipper.status()["followers"]
+                ["bench-replica-0"]["shippedBytes"] / 1e6, 2),
+        }
+    finally:
+        for f in followers:
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for rep in replicas:
+            rep.close()
+        if server is not None:
+            server.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_checkpoint(store):
     """Checkpoint at bench scale (VERDICT r3 item 8): snapshot the
     streamed store, restore it, and require bit-identical answers to a
@@ -1714,6 +1899,18 @@ def main():
             timeout_s=900, label="windows")
         emit("stream+queries+exactness+archive+pipeline+durability"
              "+windows")
+        # WAL-shipped replication (r15 tentpole, zipkin_tpu.replicate):
+        # replica staleness lag under full ingest load, failover RTO,
+        # aggregate sketch-tier queries/s across the device-free
+        # replica fleet, bitwise agreement at the drained frontier.
+        # Bounded like its neighbors.
+        detail["replication"] = _bounded(
+            lambda: bench_replication(
+                int(2e4) if args.smoke else int(2e5),
+                n_replicas=2 if args.smoke else 3),
+            timeout_s=900, label="replication")
+        emit("stream+queries+exactness+archive+pipeline+durability"
+             "+windows+replication")
         # Ingest roofline round 2 (r12 tentpole): spans/s per
         # (batch_spans, sort-path, scatter-path) arm — the evidence
         # the batch-escalation knee and the >=300k spans/s cert read

@@ -818,6 +818,202 @@ def run_windows() -> dict:
     }
 
 
+def run_replication() -> dict:
+    """WAL-shipped replication phase (r15 tentpole), proven
+    structurally on every CI run: (a) a device-free ReplicaSpanStore
+    fed only shipped WAL records over the real framed-TCP ship path
+    answers the sketch tier BITWISE identical to the primary at the
+    same applied frontier (mirror arrays equal element-for-element;
+    catalog/quantile/top-k/HLL/trace-read answers equal) — while
+    performing ZERO jit compiles (it is device-free by construction,
+    and the warm standby replays into already-compiled shapes);
+    (b) a warm standby fed the same stream lands a state bitwise equal
+    to the primary's, and promoting it (the failover RTO) is
+    measured; (c) the follower kept its lag bounded under full ingest
+    load and caught up to lag 0 at the drained frontier, with the
+    un-fetched tail pinned against truncation by its cursor."""
+    import os  # noqa: F401 — tempdir cleanup below
+    import shutil
+    import tempfile
+
+    from zipkin_tpu.replicate import (
+        Follower,
+        ReplicaTarget,
+        ShipClient,
+        ShipServer,
+        StandbyTarget,
+        WalShipper,
+    )
+    from zipkin_tpu.replicate.protocol import config_from_dict
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.archive import TieredSpanStore
+    from zipkin_tpu.store.replica import ReplicaSpanStore
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.testing.crash import states_bitwise_equal
+    from zipkin_tpu.tracegen import generate_traces
+    from zipkin_tpu.wal import WriteAheadLog
+
+    # The run_wal geometry — the ingest-step compiles are shared, so
+    # this phase's primary AND standby drives hit warm jit caches.
+    config = dev.StoreConfig(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=128, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=512,
+    )
+    # 2560 = 20 aligned chunks: enough to lap the 1<<10 ring several
+    # times (captures + cold segments on both drives) while keeping
+    # the phase's three drives inside the tier-1 wall budget.
+    traces = generate_traces(n_traces=2000, max_depth=3, n_services=16)
+    spans = [s for t in traces for s in t][:2560]
+    chunk = 128
+    root = tempfile.mkdtemp(prefix="replication-smoke-")
+    server = None
+    followers = []
+    stores = []
+    try:
+        # Warm-up: the EXACT stream through an identical (discarded)
+        # tiered store compiles every pad bucket and capture-window
+        # variant the real drive will hit, so the compile-count delta
+        # below is attributable to replication alone.
+        warm = TieredSpanStore(TpuSpanStore(config))
+        for i in range(0, len(spans), chunk):
+            warm.apply(spans[i:i + chunk])
+
+        primary = TieredSpanStore(TpuSpanStore(config))
+        wal = WriteAheadLog(os.path.join(root, "wal"), fsync="off")
+        primary.attach_wal(wal)
+        shipper = WalShipper(primary)
+        server = ShipServer(shipper, host="127.0.0.1", port=0)
+        port = server.server_address[1]
+        server.serve_in_thread()
+
+        # Chunk-ALIGNED split: a half boundary off the chunk grid would
+        # shift every second-half chunk boundary off the warm drive's
+        # (different ann-count pads -> a spurious "recompile").
+        half = (len(spans) // 2 // chunk) * chunk
+        for i in range(0, half, chunk):
+            primary.apply(spans[i:i + chunk])
+        compiles0 = dev.compile_count() + dev.query_compile_count()
+
+        rc = ShipClient("127.0.0.1", port, "smoke-replica",
+                        mode="replica")
+        replica = ReplicaSpanStore(config_from_dict(
+            rc.connect()["config"]))
+        stores.append(replica)
+        f_rep = Follower(ReplicaTarget(replica), rc,
+                         poll_interval_s=0.002).start()
+        followers.append(f_rep)
+        sc = ShipClient("127.0.0.1", port, "smoke-standby",
+                        mode="standby")
+        sc.connect()
+        standby = TpuSpanStore(config)
+        f_sby = Follower(StandbyTarget(standby), sc,
+                         poll_interval_s=0.002).start()
+        followers.append(f_sby)
+
+        # Load phase: keep ingesting while the followers stream.
+        max_lag = 0
+        for i in range(half, len(spans), chunk):
+            primary.apply(spans[i:i + chunk])
+            max_lag = max(max_lag, f_rep.lag_records())
+        wal.sync()
+        # Failover clock starts at the primary's last write: RTO =
+        # standby applies the remaining durable tail + promote.
+        t0 = time.perf_counter()
+        sby_up = f_sby.drain(60.0)
+        promoted = f_sby.promote()
+        rto_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        rep_up = f_rep.drain(60.0)
+        catch_up_s = time.perf_counter() - t0
+        caught_up = sby_up and rep_up
+        standby_bitwise = states_bitwise_equal(
+            primary.hot.state, promoted.state)
+        # Measured HERE — after the whole replication stream applied
+        # but before the agreement reads (the primary's query kernels
+        # compile on their first use in this geometry; those are read
+        # compiles, not replication's).
+        replication_compiles = (dev.compile_count()
+                                + dev.query_compile_count()
+                                - compiles0)
+
+        # Replica agreement at the drained frontier.
+        hot = primary.hot
+        a_p = hot.ensure_sketch_mirror().arrays()
+        a_r = replica.sketch_mirror.arrays()
+        import numpy as np
+
+        mirror_bitwise = all(
+            np.array_equal(x, y) for x, y in zip(a_p, a_r))
+        svcs = sorted(primary.get_all_service_names())
+        end_ts = 1 << 62
+        tids = sorted({s.trace_id for s in spans[::97]})[:24]
+        agree = replica.get_all_service_names() == set(svcs)
+        for svc in svcs[:4]:
+            agree &= (replica.service_duration_quantiles(
+                svc, [0.5, 0.95, 0.99])
+                == primary.service_duration_quantiles(
+                    svc, [0.5, 0.95, 0.99]))
+            agree &= (replica.top_annotations(svc)
+                      == primary.top_annotations(svc))
+            agree &= (replica.top_binary_keys(svc)
+                      == primary.top_binary_keys(svc))
+            agree &= (replica.get_trace_ids_by_name(
+                svc, None, end_ts, 10)
+                == primary.get_trace_ids_by_name(svc, None, end_ts,
+                                                 10))
+        agree &= (replica.estimated_unique_traces()
+                  == primary.estimated_unique_traces())
+        agree &= (replica.get_spans_by_trace_ids(tids)
+                  == primary.get_spans_by_trace_ids(tids))
+        agree &= (replica.traces_exist(tids)
+                  == primary.traces_exist(tids))
+        agree &= (replica.get_traces_duration(tids)
+                  == primary.get_traces_duration(tids))
+
+        # Sketch-tier latency off the replica (pure numpy).
+        from zipkin_tpu import obs
+
+        sk = obs.LatencySketch("bench_replica_sketch_seconds",
+                               "replica sketch-tier serve")
+        for i in range(60):
+            t0 = time.perf_counter()
+            replica.service_duration_quantiles(
+                svcs[i % len(svcs)], [0.5, 0.99])
+            sk.observe(time.perf_counter() - t0)
+        p50_ms = sk.snapshot()["p50"] * 1e3
+
+        status = shipper.status()
+        cursors = wal.cursors()
+        return {
+            "spans": len(spans),
+            "records_shipped": int(
+                status["followers"]["smoke-replica"]["shippedRecords"]),
+            "shipped_bytes": int(
+                status["followers"]["smoke-replica"]["shippedBytes"]),
+            "replica_mirror_bitwise": bool(mirror_bitwise),
+            "replica_answers_identical": bool(agree),
+            "replication_recompiles": int(replication_compiles),
+            "standby_bitwise": bool(standby_bitwise),
+            "failover_rto_s": round(max(rto_s, 1e-4), 4),
+            "max_lag_records": int(max_lag),
+            "caught_up": bool(caught_up),
+            "catch_up_s": round(catch_up_s, 3),
+            "replica_sketch_p50_ms": round(p50_ms, 3),
+            "follower_cursor_pinned": bool(
+                cursors.get("smoke-replica", 0) >= 1),
+        }
+    finally:
+        for f in followers:
+            f.close()
+        for s in stores:
+            s.close()
+        if server is not None:
+            server.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_lint() -> dict:
     """graftlint phase (tier-1 gated): the concurrency/JAX-hazard
     analyzer (zipkin_tpu/analysis, docs/STATIC_ANALYSIS.md) over the
@@ -967,6 +1163,7 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         "query": run_query(),
         "ingest_structure": run_ingest_structure(),
         "windows": run_windows(),
+        "replication": run_replication(),
         "lint": run_lint(),
         # The main stream runs the library default (window arena OFF),
         # so its step census gates at the BASE ceilings; the windows

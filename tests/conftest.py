@@ -129,6 +129,18 @@ _SLOW_TESTS = {
     # already smoke-gates mirror bitwise identity every tier-1 run).
     "test_window_ring_wrap_deep_sweep",
     "test_pre_rev14_checkpoint_restores_empty_arena",
+    # Replication deep coverage (tests/test_replication.py): tier-1
+    # keeps the durable-only ship bound, gap/idempotency, standby
+    # promote, and the pre-rev-14 cold-resync compat path, and
+    # bench_smoke's replication phase smoke-gates replica bitwise
+    # agreement + RTO every tier-1 run; the full agreement sweep, the
+    # TCP anchor-bootstrap drive, and the retention soak re-drive
+    # multi-thousand-span stores the fast-lane wall budget can't
+    # afford (the crash-during-ship matrix is marked slow directly).
+    "test_replica_bitwise_agreement_at_fixed_frontier",
+    "test_tcp_follow_and_anchor_bootstrap",
+    "test_replica_retention_drops_old_segments",
+    "test_standby_follow_promote_bitwise",
 }
 
 

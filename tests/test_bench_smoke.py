@@ -186,6 +186,27 @@ def test_bench_smoke_json_and_op_ceilings():
     assert w["burn_errors"] >= 1, w
     assert w["heatmap_columns"] >= 1, w
     assert w["window_spans_folded"] > 0, w
+    # Replication phase (r15 tentpole): a device-free ReplicaSpanStore
+    # fed only shipped WAL records over the real framed-TCP ship path
+    # must answer the sketch tier and row reads BITWISE identical to
+    # the primary at the same applied frontier (mirror arrays equal
+    # element-for-element), the whole replication stream must add
+    # ZERO jit compiles (the replica is device-free; the warm standby
+    # replays into already-compiled shapes), the standby must land a
+    # bitwise-equal device state with a measured failover RTO, the
+    # follower must catch up to lag 0 under full ingest load, and its
+    # cursor must be pinned in the WAL's retention registry.
+    rep = rec["replication"]
+    assert rep["replica_mirror_bitwise"] is True, rep
+    assert rep["replica_answers_identical"] is True, rep
+    assert rep["replication_recompiles"] == 0, rep
+    assert rep["standby_bitwise"] is True, rep
+    assert 0 < rep["failover_rto_s"] < 60.0, rep
+    assert rep["caught_up"] is True, rep
+    assert rep["records_shipped"] >= 1, rep
+    assert rep["shipped_bytes"] > 0, rep
+    assert rep["replica_sketch_p50_ms"] < 10.0, rep
+    assert rep["follower_cursor_pinned"] is True, rep
     # graftlint phase (this PR's tentpole): the concurrency/JAX-hazard
     # analyzer must cover the whole package, find ZERO findings not in
     # the checked-in baseline, and stay inside its 30s budget (the

@@ -334,7 +334,7 @@ class TestMains:
         args = build_parser().parse_args(
             ["--memory-store", "--seed-traces", "2"]
         )
-        store, collector, api = build_app(args)
+        store, collector, api, _shipper = build_app(args)
         seed(collector, 2)
         status, services = api.handle("GET", "/api/services", {})
         assert status == 200 and services

@@ -325,3 +325,51 @@ def test_sharded_dictionary_overflow_service_routes_to_scan(mesh):
         assert sharded.service_duration_quantiles(svc, [0.5, 0.99]) == \
             big.service_duration_quantiles(svc, [0.5, 0.99]), svc
     assert sharded.get_all_service_names() == big.get_all_service_names()
+
+
+def test_concurrent_catalog_reads_do_not_deadlock(mesh):
+    """The r14-noted hazard: N API threads each launching a shard_map
+    collective (psum catalogs, HLL pmax) under the SHARED read lock
+    interleave their per-device rendezvous on the XLA CPU backend and
+    hang forever. ShardedSpanStore serializes collective launches
+    behind the dedicated _coll_lock leaf — this drives the exact
+    pattern (concurrent catalog + quantile + cardinality reads) and
+    gates completion with a hard timeout."""
+    import threading
+
+    store = ShardedSpanStore(mesh, CFG)
+    spans = [
+        s for t in generate_traces(n_traces=10, max_depth=3,
+                                   n_services=6) for s in t
+    ]
+    store.apply(spans)
+    # Single-threaded warm-up compiles every kernel the workers hit,
+    # so the timeout below bounds rendezvous stalls, not compiles.
+    svc = sorted(store.get_all_service_names())[0]
+    store.service_duration_quantiles(svc, [0.5, 0.99])
+    store.estimated_unique_traces()
+    store.get_span_names(svc)
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(3):
+                assert store.get_all_service_names()
+                assert store.service_duration_quantiles(
+                    svc, [0.5, 0.99]) is not None
+                assert store.estimated_unique_traces() > 0
+                store.get_span_names(svc)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120.0)
+    hung = [t for t in threads if t.is_alive()]
+    assert not hung, (
+        f"{len(hung)} catalog reader(s) deadlocked at the collective "
+        f"rendezvous — the _coll_lock serialization regressed")
+    assert not errors, errors

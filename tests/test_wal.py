@@ -277,6 +277,89 @@ class TestPoliciesAndTruncation:
         with pytest.raises(RuntimeError):
             wal.append(b"late")
 
+    def test_truncate_respects_follower_cursor(self, tmp_path):
+        """The shipping retention pin: a registered follower cursor
+        clamps truncation so no un-fetched record's segment is ever
+        deleted — then releases cleanly when the cursor advances or
+        drops (docs/REPLICATION.md)."""
+        d = str(tmp_path / "w")
+        wal = WriteAheadLog(d, fsync="off", segment_bytes=1 << 12,
+                            compress=False)
+        pays = _payloads(30, size=300)
+        for p in pays:
+            wal.append(p)
+        wal.sync()
+        wal.register_cursor("f1", 4)
+        before = len([n for n in os.listdir(d) if n.endswith(".seg")])
+        wal.truncate(upto_seq=20)  # clamped to the cursor (4)
+        # Everything past the cursor is still replayable in full.
+        assert [p for _, p in wal.replay(4)] == pays[4:]
+        assert wal.first_available_seq() <= 5
+        # Cursor catches up: the covered prefix can now go.
+        wal.advance_cursor("f1", 20)
+        removed = wal.truncate(upto_seq=20)
+        assert removed >= 1
+        assert [p for _, p in wal.replay(20)] == pays[20:]
+        # A re-register can never move a pin BACKWARD.
+        wal.register_cursor("f1", 3)
+        assert wal.cursors()["f1"] == 20
+        # Dropped cursor: truncation behaves exactly as before.
+        wal.drop_cursor("f1")
+        wal.truncate(upto_seq=30)
+        assert list(wal.replay(0)) == []
+        after = len([n for n in os.listdir(d) if n.endswith(".seg")])
+        assert after < before
+        assert wal.append(b"tail") == 31  # chain intact
+        wal.close()
+
+    def test_unpinned_log_truncates_exactly_as_before(self, tmp_path):
+        """No cursors + retain_bytes=0 must reproduce the historical
+        truncation byte-for-byte: same segments deleted, same
+        survivors, against a twin log driven identically."""
+        pays = _payloads(30, size=300)
+
+        def drive(name, **kw):
+            w = WriteAheadLog(str(tmp_path / name), fsync="off",
+                              segment_bytes=1 << 12, compress=False,
+                              **kw)
+            for p in pays:
+                w.append(p)
+            w.sync()
+            removed = w.truncate(upto_seq=20)
+            segs = sorted(os.path.basename(s.path)
+                          for s in w._segments)
+            tail = [p for _, p in w.replay(0)]
+            w.close()
+            return removed, segs, tail
+
+        base = drive("plain")
+        twin = drive("twin", retain_bytes=0)
+        assert base == twin
+
+    def test_retain_bytes_keeps_covered_tail(self, tmp_path):
+        """--wal-retain-bytes: the newest covered segments survive
+        truncation up to the byte floor, so a reconnecting follower
+        catches up from the log instead of re-anchoring."""
+        d = str(tmp_path / "w")
+        pays = _payloads(30, size=300)
+        wal = WriteAheadLog(d, fsync="off", segment_bytes=1 << 12,
+                            compress=False, retain_bytes=1 << 30)
+        for p in pays:
+            wal.append(p)
+        wal.sync()
+        # Everything is covered, but the (huge) floor protects it all.
+        assert wal.truncate(upto_seq=30) == 0
+        assert [p for _, p in wal.replay(0)] == pays
+        # Shrink the floor to ~one segment: older segments now go,
+        # the newest stay.
+        wal.retain_bytes = 1 << 12
+        removed = wal.truncate(upto_seq=30)
+        assert removed >= 1
+        kept = [p for _, p in wal.replay(0)]
+        assert kept == pays[len(pays) - len(kept):]  # a strict suffix
+        assert kept  # floor kept at least the newest segment
+        wal.close()
+
 
 class _HalfWriteFile:
     """Wraps the segment file: the first write lands HALF the frame

@@ -30,6 +30,7 @@ from zipkin_tpu.ingest.receiver import (
 )
 from zipkin_tpu.query.request import QueryException
 from zipkin_tpu.query.service import QueryService
+from zipkin_tpu.store.base import StorageException
 
 DEFAULT_PIN_TTL_S = 30 * 24 * 3600  # webPinTtl default 30 days
 DEFAULT_TTL_S = 1.0
@@ -109,10 +110,15 @@ class ApiServer:
                  pin_ttl_s: float = DEFAULT_PIN_TTL_S,
                  self_trace: bool = True,
                  self_service_name: str = "zipkin-tpu",
-                 registry: Optional[obs.Registry] = None):
+                 registry: Optional[obs.Registry] = None,
+                 replication=None):
         self.query = query
         self.collector = collector
         self.pin_ttl_s = pin_ttl_s
+        # /api/replication status provider: a zero-arg callable — the
+        # primary's WalShipper.status or a follower's Follower.status
+        # (docs/REPLICATION.md); None answers {"role": "none"}.
+        self.replication = replication
         self.registry = registry or obs.default_registry()
         # Query-stage latency sketch: p50/p99 per normalized route
         # (moments + log-histogram, see obs.LatencySketch).
@@ -287,6 +293,10 @@ class ApiServer:
             return 404, {"error": f"not found: {e}"}
         except (ValueError, json.JSONDecodeError) as e:
             return 400, {"error": str(e)}
+        except StorageException as e:
+            # A write reaching a read replica (store/replica.py), or a
+            # suspect/closing store: the request is routable elsewhere.
+            return 503, {"error": str(e)}
 
     def _route(self, method, path, params, body):
         if path in ("/", "/index.html", "/traces", "/aggregate"):
@@ -350,6 +360,10 @@ class ApiServer:
             return 200, {
                 "dataTimeToLive": self.query.get_data_time_to_live()
             }
+        if path == "/api/replication":
+            if self.replication is None:
+                return 200, {"role": "none"}
+            return 200, self.replication()
         if path == "/api/dependencies" or re.match(r"^/api/dependencies/", path):
             return self._dependencies(path, params)
         if path == "/api/traces_exist":
@@ -691,7 +705,7 @@ _KNOWN_ROUTES = frozenset((
     "/api/quantiles", "/api/dependencies", "/api/traces_exist",
     "/api/span_durations", "/api/service_names_to_trace_ids",
     "/api/data_ttl", "/api/windowed_quantiles", "/api/slo_burn",
-    "/api/latency_heatmap", "/scribe",
+    "/api/latency_heatmap", "/api/replication", "/scribe",
 ))
 
 

@@ -104,6 +104,43 @@ def build_parser() -> argparse.ArgumentParser:
                    help="roll WAL segment files at this size; whole "
                         "segments are deleted once a checkpoint "
                         "covers them")
+    p.add_argument("--wal-retain-bytes", type=int, default=0,
+                   help="shipping retention floor: keep at least this "
+                        "many newest WAL bytes on disk even when a "
+                        "checkpoint covers them, so reconnecting "
+                        "followers catch up from the log instead of "
+                        "re-anchoring (0 = truncate everything "
+                        "covered; registered follower cursors always "
+                        "pin regardless — docs/REPLICATION.md)")
+    p.add_argument("--ship-port", type=int, default=0,
+                   help="serve sealed WAL records to replication "
+                        "followers on this framed-TCP port (0 "
+                        "disables; requires --wal-dir — "
+                        "docs/REPLICATION.md)")
+    p.add_argument("--follow", default=None, metavar="HOST:PORT",
+                   help="run as a replication follower of the primary "
+                        "at HOST:PORT instead of a collector daemon: "
+                        "no ingest ports open, reads serve from the "
+                        "replicated store, staleness is exposed at "
+                        "/api/replication")
+    p.add_argument("--follow-mode", default="replica",
+                   choices=("replica", "standby"),
+                   help="follower role: 'replica' = device-free CPU "
+                        "read replica (SketchMirror + cold segments, "
+                        "no TPU); 'standby' = full device store "
+                        "replaying through the normal commit body, "
+                        "ready for failover")
+    p.add_argument("--follow-poll-ms", type=float, default=20.0,
+                   help="follower fetch-poll cadence when the primary "
+                        "has nothing new (each fetch is also the ack "
+                        "that advances the primary's retention pin)")
+    p.add_argument("--follower-name", default=None,
+                   help="stable follower identity for the primary's "
+                        "cursor registry (default: <mode>-<hostname> — "
+                        "STABLE across restarts, so a restarted "
+                        "follower reuses its retention pin instead of "
+                        "leaking a dead one; set explicitly when "
+                        "running several same-mode followers per host)")
     p.add_argument("--query-window-ms", type=float, default=None,
                    help="resident query executor micro-batch window "
                         "(ms): how long an idle-entry request waits "
@@ -245,6 +282,7 @@ def build_app(args):
             args.wal_dir, fsync=args.wal_fsync,
             interval_s=args.wal_fsync_interval,
             segment_bytes=args.wal_segment_bytes,
+            retain_bytes=args.wal_retain_bytes,
         )
         # Boot-time recovery: the checkpoint (restored above, or a
         # fresh store) is the base; every WAL record past its applied
@@ -267,11 +305,80 @@ def build_app(args):
         self_trace=not args.no_self_trace_ingest,
         pipeline_depth=args.pipeline_depth,
     )
+    shipper = None
+    if args.ship_port:
+        if getattr(store, "wal", None) is None:
+            raise SystemExit("--ship-port requires --wal-dir (sealed "
+                             "WAL records are what gets shipped)")
+        from zipkin_tpu.replicate import WalShipper
+
+        shipper = WalShipper(store)
     window_s = (args.query_window_ms / 1000.0
                 if args.query_window_ms is not None else None)
-    api = ApiServer(QueryService(store, coalesce_window_s=window_s),
-                    collector)
-    return store, collector, api
+    api = ApiServer(
+        QueryService(store, coalesce_window_s=window_s), collector,
+        replication=shipper.status if shipper is not None else None,
+    )
+    return store, collector, api, shipper
+
+
+def build_follower_app(args):
+    """Follower daemon (--follow): connect to the primary's ship port,
+    build the local store from the primary's config, and serve the
+    read API from it — no ingest ports, no collector. Returns
+    (store, follower, api)."""
+    import socket as _socket
+
+    from zipkin_tpu.api.server import ApiServer
+    from zipkin_tpu.query.service import QueryService
+    from zipkin_tpu.replicate import (
+        Follower,
+        ReplicaTarget,
+        ShipClient,
+        StandbyTarget,
+    )
+    from zipkin_tpu.replicate.protocol import config_from_dict
+
+    host, _, port = args.follow.rpartition(":")
+    if not host or not port.isdigit():
+        raise SystemExit(f"--follow wants HOST:PORT, got {args.follow!r}")
+    # No PID in the default: the name keys the primary's retention pin,
+    # and a per-process name would leak one pinned cursor per restart
+    # (truncation blocked at the dead cursor forever).
+    name = args.follower_name or (
+        f"{args.follow_mode}-{_socket.gethostname()}")
+    client = ShipClient(host, int(port), name, mode=args.follow_mode)
+    hello = client.connect()
+    config = config_from_dict(hello["config"])
+    if args.follow_mode == "standby":
+        from zipkin_tpu.store.tpu import TpuSpanStore
+
+        store = None
+        if args.checkpoint:
+            from zipkin_tpu import checkpoint
+
+            # Anchor bootstrap for a standby is a CHECKPOINT of the
+            # primary lineage: the shipped tail replays on top of it
+            # exactly like crash recovery would.
+            if checkpoint.exists(args.checkpoint):
+                store = checkpoint.load(args.checkpoint)
+        if store is None:
+            store = TpuSpanStore(config)
+        target = StandbyTarget(store)
+    else:
+        from zipkin_tpu.store.replica import ReplicaSpanStore
+
+        store = ReplicaSpanStore(config)
+        target = ReplicaTarget(store)
+    follower = Follower(target, client,
+                        poll_interval_s=args.follow_poll_ms / 1000.0)
+    window_s = (args.query_window_ms / 1000.0
+                if args.query_window_ms is not None else None)
+    api = ApiServer(
+        QueryService(store, coalesce_window_s=window_s), None,
+        replication=follower.status,
+    )
+    return store, follower, api
 
 
 def seed(collector, n_traces: int) -> None:
@@ -282,13 +389,77 @@ def seed(collector, n_traces: int) -> None:
     collector.flush()
 
 
+def follower_main(args) -> None:
+    """The --follow serving loop: read-only API over the replicated
+    store; SIGTERM/SIGINT stop the follower cleanly (a standby with
+    --checkpoint snapshots on the same cadence as a primary, so its
+    own recovery base stays fresh)."""
+    from zipkin_tpu.api.server import make_server, serve_forever_in_thread
+
+    store, follower, api = build_follower_app(args)
+    follower.start()
+    server = make_server(api, args.host, args.port)
+    serve_forever_in_thread(server)
+    print(f"zipkin-tpu {args.follow_mode} following {args.follow}, "
+          f"serving reads on {args.host}:{args.port}")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    can_checkpoint = (args.follow_mode == "standby" and args.checkpoint)
+    last_ckpt = time.time()
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+            err = follower.error()
+            if err is not None and not follower.status()["connected"]:
+                # Transient disconnects retry inside the loop; only a
+                # terminal lineage error lands here with the thread
+                # stopped.
+                if follower._thread is None or not \
+                        follower._thread.is_alive():
+                    print(f"follower stopped: {err!r}")
+                    break
+            if (can_checkpoint
+                    and time.time() - last_ckpt
+                    > args.checkpoint_interval):
+                from zipkin_tpu import checkpoint
+
+                # Captured BEFORE the save: the snapshot covers at
+                # least this frontier (records applied mid-save only
+                # push the manifest higher), so acking it after a
+                # successful save is always conservative.
+                seq = follower.target.applied_seq()
+                checkpoint.save(store, args.checkpoint)
+                # The standby's retention ack is its CHECKPOINTED
+                # frontier — only now may the primary truncate the
+                # covered records (replicate/follow.StandbyTarget).
+                follower.target.note_checkpointed(seq)
+                last_ckpt = time.time()
+    finally:
+        server.shutdown()
+        follower.close()
+        if can_checkpoint:
+            try:
+                from zipkin_tpu import checkpoint
+
+                checkpoint.save(store, args.checkpoint)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+        store.close()
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-    store, collector, api = build_app(args)
+    if args.follow:
+        follower_main(args)
+        return
+    store, collector, api, shipper = build_app(args)
     if args.seed_traces:
         seed(collector, args.seed_traces)
 
@@ -296,6 +467,12 @@ def main(argv=None) -> None:
 
     server = make_server(api, args.host, args.port)
     serve_forever_in_thread(server)
+    ship_srv = None
+    if shipper is not None:
+        from zipkin_tpu.replicate import ShipServer
+
+        ship_srv = ShipServer(shipper, args.host, args.ship_port)
+        ship_srv.serve_in_thread()
     scribe_srv = None
     if args.scribe_port:
         from zipkin_tpu.ingest.receiver import ScribeReceiver
@@ -317,7 +494,8 @@ def main(argv=None) -> None:
         scribe_srv = ScribeServer(receiver, args.host, args.scribe_port)
         scribe_srv.serve_in_thread()
     print(f"zipkin-tpu example serving on {args.host}:{args.port}"
-          + (f" (scribe tcp :{args.scribe_port})" if scribe_srv else ""))
+          + (f" (scribe tcp :{args.scribe_port})" if scribe_srv else "")
+          + (f" (wal-ship tcp :{args.ship_port})" if ship_srv else ""))
 
     stop = threading.Event()
     # SIGINT and SIGTERM share the graceful-save path: both land in
@@ -350,6 +528,8 @@ def main(argv=None) -> None:
         # covered log segments. close() comes last.
         if scribe_srv is not None:
             scribe_srv.shutdown()
+        if ship_srv is not None:
+            ship_srv.shutdown()
         server.shutdown()
         try:
             collector.flush()
@@ -370,6 +550,8 @@ def main(argv=None) -> None:
 
             traceback.print_exc()
         collector.close()
+        if shipper is not None:
+            shipper.close()
         wal = getattr(store, "wal", None)
         if wal is not None:
             wal.close()

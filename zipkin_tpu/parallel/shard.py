@@ -317,6 +317,18 @@ class ShardedSpanStore(SuspectGuard):
         self._lock = threading.Lock()  # lock-order: 10 encode
         self._rw = RWLock()  # lock-order: 40 commit
         self._kernels_lock = threading.Lock()  # lock-order: 75 kernel-cache
+        # Collective-launch serializer (the r14-noted deadlock): every
+        # mapped read kernel is a shard_map program whose collectives
+        # rendezvous ALL mesh devices inside one launch. The XLA CPU
+        # backend runs concurrent launches on a shared device pool, so
+        # two collective programs in flight can each seize a subset of
+        # the per-device rendezvous slots and wait forever for the
+        # rest (N API threads x psum catalogs under the SHARED read
+        # lock — the read lock never excluded reader/reader). One
+        # launch at a time makes the rendezvous trivially complete.
+        # Dedicated LEAF below the read-lock hold (40 -> 45); never
+        # held across anything that blocks on another launch.
+        self._coll_lock = threading.Lock()  # lock-order: 45 collective-launch
 
     @property
     def dicts(self):
@@ -471,6 +483,16 @@ class ShardedSpanStore(SuspectGuard):
             with self._kernels_lock:
                 fn = self._kernels.setdefault(key, fn)
         return fn
+
+    def _collect(self, kernel, *args):
+        """Launch one mapped collective kernel and fetch its result,
+        serialized behind the collective-launch leaf lock: concurrent
+        shard_map programs deadlock the XLA CPU collective rendezvous
+        (see _coll_lock). Callers hold the read lock; the launch AND
+        the device_get complete inside the hold, so no second
+        collective can be in flight."""
+        with self._coll_lock:
+            return jax.device_get(kernel(*args))
 
     def _unstack(self, state):
         return jax.tree.map(lambda x: x[0], state)
@@ -655,12 +677,11 @@ class ShardedSpanStore(SuspectGuard):
     def _durations_mat(self, qids):
         with self._rw.read():
             if self.config.use_index:
-                mat, exact = jax.device_get(
-                    self._iq_durations()(self.states, qids)
-                )
+                mat, exact = self._collect(
+                    self._iq_durations(), self.states, qids)
                 if exact:
                     return mat
-            return jax.device_get(self._q_durations()(self.states, qids))
+            return self._collect(self._q_durations(), self.states, qids)
 
     def _iq_gather(self, k_s: int, k_a: int, k_b: int):
         """Per-shard trace-membership gather (dev.iquery_gather_trace_rows)
@@ -691,9 +712,8 @@ class ShardedSpanStore(SuspectGuard):
         from zipkin_tpu.store.base import index_gather_with_escalation
 
         def fetch(k_s, k_a, k_b):
-            counts, s_m, a_m, b_m, exact = jax.device_get(
-                self._iq_gather(k_s, k_a, k_b)(self.states, qids)
-            )
+            counts, s_m, a_m, b_m, exact = self._collect(
+                self._iq_gather(k_s, k_a, k_b), self.states, qids)
             return (bool(exact), int(counts[:, 0].max()),
                     int(counts[:, 1].max()), int(counts[:, 2].max()),
                     (counts, s_m, a_m, b_m))
@@ -777,19 +797,18 @@ class ShardedSpanStore(SuspectGuard):
 
         def fetch(k):
             with self._rw.read():
-                mats = jax.device_get(self._q_by_service(k)(
-                    self.states, jnp.int32(svc), jnp.int32(name_lc),
-                    jnp.int64(end_ts),
-                ))
+                mats = self._collect(
+                    self._q_by_service(k), self.states, jnp.int32(svc),
+                    jnp.int32(name_lc), jnp.int64(end_ts),
+                )
             return self._shard_candidates(mats, k)
 
         def index_fetch(k):
             with self._rw.read():
-                mats, complete, wm = jax.device_get(
-                    self._iq_by_service(k, name_lc >= 0)(
-                        self.states, jnp.int32(svc), jnp.int32(name_lc),
-                        jnp.int64(end_ts),
-                    )
+                mats, complete, wm = self._collect(
+                    self._iq_by_service(k, name_lc >= 0), self.states,
+                    jnp.int32(svc), jnp.int32(name_lc),
+                    jnp.int64(end_ts),
                 )
             cands, truncated = self._shard_candidates(mats, k)
             # window > len(cands) ⇔ no shard's window truncated: only
@@ -828,11 +847,12 @@ class ShardedSpanStore(SuspectGuard):
 
         def fetch(k):
             with self._rw.read():
-                mats = jax.device_get(self._q_by_annotation(k)(
-                    self.states, jnp.int32(svc), jnp.int32(ann_value),
+                mats = self._collect(
+                    self._q_by_annotation(k), self.states,
+                    jnp.int32(svc), jnp.int32(ann_value),
                     jnp.int32(bann_key), jnp.int32(bann_value),
                     jnp.int32(bann_value2), jnp.int64(end_ts),
-                ))
+                )
             return self._shard_candidates(mats, k)
 
         if ann_value >= 0:
@@ -849,12 +869,11 @@ class ShardedSpanStore(SuspectGuard):
 
         def index_fetch(k):
             with self._rw.read():
-                mats, complete, wm = jax.device_get(
-                    self._iq_by_annotation(k, mode)(
-                        self.states, jnp.int32(svc), jnp.int32(ann_value),
-                        jnp.int32(bann_key), jnp.int32(bv1),
-                        jnp.int32(bv2), jnp.int64(end_ts),
-                    )
+                mats, complete, wm = self._collect(
+                    self._iq_by_annotation(k, mode), self.states,
+                    jnp.int32(svc), jnp.int32(ann_value),
+                    jnp.int32(bann_key), jnp.int32(bv1),
+                    jnp.int32(bv2), jnp.int64(end_ts),
                 )
             cands, truncated = self._shard_candidates(mats, k)
             window = len(cands) if truncated else len(cands) + 1
@@ -930,11 +949,10 @@ class ShardedSpanStore(SuspectGuard):
             order = ("b_base", "s_base", "n_b", "depth", "key1", "key2",
                      "key3", "three", "is_svc", "end_ts", "poison_on")
             with self._rw.read():
-                mats, completes, wms = jax.device_get(
-                    self._iq_multi(len(arrs["key1"]), k_eff)(
-                        self.states,
-                        *(jnp.asarray(arrs[name]) for name in order),
-                    )
+                mats, completes, wms = self._collect(
+                    self._iq_multi(len(arrs["key1"]), k_eff),
+                    self.states,
+                    *(jnp.asarray(arrs[name]) for name in order),
                 )
             per_probe = []
             for pi, p in enumerate(probes):
@@ -1020,9 +1038,9 @@ class ShardedSpanStore(SuspectGuard):
                 payload = self._gather_via_index(qids)
             if payload is None:
                 def fetch(k_s, k_a, k_b):
-                    counts, s_m, a_m, b_m = jax.device_get(
-                        self._q_gather(k_s, k_a, k_b)(self.states, qids)
-                    )
+                    counts, s_m, a_m, b_m = self._collect(
+                        self._q_gather(k_s, k_a, k_b), self.states,
+                        qids)
                     return (int(counts[:, 0].max()),
                             int(counts[:, 1].max()),
                             int(counts[:, 2].max()),
@@ -1057,10 +1075,11 @@ class ShardedSpanStore(SuspectGuard):
         """Read-locked fetch of one collective catalog entry (optionally
         one row of it) — a single D2H transfer."""
         with self._rw.read():
-            entry = self._cat_kernel(key)(self.states)
-            if row is not None:
-                entry = entry[row]
-            return jax.device_get(entry)
+            with self._coll_lock:
+                entry = self._cat_kernel(key)(self.states)
+                if row is not None:
+                    entry = entry[row]
+                return jax.device_get(entry)
 
     def get_all_service_names(self):
         present = self._cat("ann_svc_counts") > 0
@@ -1091,10 +1110,9 @@ class ShardedSpanStore(SuspectGuard):
                 ))
 
             with self._rw.read():
-                pres = jax.device_get(
-                    self._kernel(("overflow_presence", pad), build)(
-                        self.states)
-                )
+                pres = self._collect(
+                    self._kernel(("overflow_presence", pad), build),
+                    self.states)
             out.update(
                 name for i in np.flatnonzero(pres[:n_over])
                 if (name := d.decode(S + int(i)))
@@ -1129,9 +1147,8 @@ class ShardedSpanStore(SuspectGuard):
         if cached is not None and cached[0] == key:
             return cached[1]
         with self._rw.read():
-            rows = jax.device_get(
-                self._scan_cat_kernel()(self.states, jnp.int32(svc))
-            )
+            rows = self._collect(self._scan_cat_kernel(), self.states,
+                                 jnp.int32(svc))
         self._svc_scan_memo = (key, rows)
         return rows
 
@@ -1199,18 +1216,18 @@ class ShardedSpanStore(SuspectGuard):
                         self.inner.sweep()
         with self._rw.read():
             if start_ts is None and end_ts is None:
-                summary = self._summary_kernel()(self.states)
-                bank, ts_min, ts_max = jax.device_get(
-                    (summary["dep_moments"], summary["ts_min"],
-                     summary["ts_max"])
-                )
+                with self._coll_lock:
+                    summary = self._summary_kernel()(self.states)
+                    bank, ts_min, ts_max = jax.device_get(
+                        (summary["dep_moments"], summary["ts_min"],
+                         summary["ts_max"])
+                    )
             else:
                 s = dev.I64_MIN if start_ts is None else int(start_ts)
                 e = dev.I64_MAX if end_ts is None else int(end_ts)
-                bank, ts_min, ts_max = jax.device_get(
-                    self._deps_range_kernel()(
-                        self.states, jnp.int64(s), jnp.int64(e)
-                    )
+                bank, ts_min, ts_max = self._collect(
+                    self._deps_range_kernel(), self.states,
+                    jnp.int64(s), jnp.int64(e)
                 )
         return dependencies_from_bank(
             bank, self.dicts.services, self.config.max_services,

@@ -1,0 +1,36 @@
+"""WAL-shipped replication: warm standbys + device-free read replicas.
+
+The production shape for "millions of concurrent viewers"
+(docs/REPLICATION.md): one chip owns the write path, sealed WAL
+records stream to followers over the framed-TCP wire layer, and
+followers run as either
+
+- a **warm standby** — a full device store replaying through the
+  normal commit body (bitwise the primary, measured-RTO failover), or
+- a **device-free replica** (store/replica.py) — SketchMirror +
+  cold-tier segments on plain CPUs, serving the whole sketch tier and
+  zone-map-pruned row queries behind the unchanged SpanStore SPI.
+
+Pieces: ``protocol`` (frames), ``ship`` (primary-side shipper +
+server, retention-pinned in the WAL), ``follow`` (the fetch-apply
+loop + targets).
+"""
+
+from zipkin_tpu.replicate.follow import (  # noqa: F401
+    Follower,
+    ReplicaTarget,
+    ShipClient,
+    StandbyTarget,
+)
+from zipkin_tpu.replicate.protocol import ShipProtocolError  # noqa: F401
+from zipkin_tpu.replicate.ship import ShipServer, WalShipper  # noqa: F401
+
+__all__ = [
+    "Follower",
+    "ReplicaTarget",
+    "ShipClient",
+    "ShipProtocolError",
+    "ShipServer",
+    "StandbyTarget",
+    "WalShipper",
+]

@@ -172,6 +172,21 @@ class SegmentDirectory:
             self._next_id = next_id
             self._decoded.clear()
 
+    def drop_below(self, gid_upto: int) -> int:
+        """Drop whole segments whose rows all precede ``gid_upto`` —
+        the read replica's retention bound (store/replica.py). The
+        tiered primary never calls this: its cold tier IS the
+        retention. Returns the number of segments dropped."""
+        with self._lock:
+            dropped = [s for s in self._segments if s.gid_hi <= gid_upto]
+            if not dropped:
+                return 0
+            self._segments = [s for s in self._segments
+                              if s.gid_hi > gid_upto]
+            for s in dropped:
+                self._decoded.pop(s.seg_id, None)
+        return len(dropped)
+
     # -- compaction -----------------------------------------------------
 
     def _find_run(self) -> Optional[List[Segment]]:

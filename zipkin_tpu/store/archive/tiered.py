@@ -36,40 +36,33 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional, Sequence, Set
 
-import numpy as np
-
 from zipkin_tpu.columnar.encode import to_signed64
 from zipkin_tpu.models.span import Span
-from zipkin_tpu.ops.quantile import quantiles_host
+from zipkin_tpu.store.archive.coldquery import (
+    ColdQueries,
+    durations_from_bounds,
+    union_topk,
+)
 from zipkin_tpu.store.archive.directory import (
     ArchiveParams,
     SegmentDirectory,
 )
-from zipkin_tpu.store.archive.segment import (
-    TAG_ANN,
-    TAG_BKEY,
-    TAG_BVAL,
-    TAG_NAME,
-    seal_segment,
-)
-from zipkin_tpu.store.archive import sketches as SK
+from zipkin_tpu.store.archive.segment import seal_segment
 from zipkin_tpu.store.base import (
     IndexedTraceId,
     SpanStore,
     TraceIdDuration,
     apply_pin_merges,
-    dedup_rank_limit,
     fill_pin,
-    resolve_annotation_query,
-)
-from zipkin_tpu.store.memory import (
-    match_spans_by_annotation,
-    match_spans_by_name,
 )
 
 
-class TieredSpanStore(SpanStore):
-    """Federates a TpuSpanStore (hot) with a SegmentDirectory (cold)."""
+class TieredSpanStore(ColdQueries, SpanStore):
+    """Federates a TpuSpanStore (hot) with a SegmentDirectory (cold).
+    The cold read half (zone pruning + oracle-match semantics) lives in
+    the shared ColdQueries mixin (store/archive/coldquery.py) — the
+    device-free ReplicaSpanStore runs the identical code over segments
+    sealed from shipped WAL records."""
 
     def __init__(self, hot, params: Optional[ArchiveParams] = None,
                  directory: Optional[SegmentDirectory] = None,
@@ -138,15 +131,11 @@ class TieredSpanStore(SpanStore):
         a fixed hot frontier pins the federated answer too."""
         return self.hot.write_frontier()
 
-    def cold_service_ids(self) -> Set[int]:
-        """Service ids present in any cold segment, from zone-map
-        metadata alone (host memory, no decompression) — the sketch
-        tier's cold half of getAllServiceNames (exact: zone service
-        sets are exact per segment, see archive/segment.py)."""
-        out: Set[int] = set()
-        for seg in self._segments():
-            out.update(seg.zone.service_ids)
-        return out
+    @property
+    def dicts(self):
+        """The dictionary set that encoded every tier's rows (the
+        ColdQueries mixin resolves query names against it)."""
+        return self.hot.dicts
 
     def capture_now(self) -> None:
         """Flush everything resident-but-uncaptured into a segment."""
@@ -206,11 +195,6 @@ class TieredSpanStore(SpanStore):
         self.hot.seal_barrier()
         return self.archive.pruned_scan(probe)
 
-    def _cold_segments_for_traces(self, qids: Set[int]):
-        return self._pruned(
-            lambda seg: any(seg.zone.may_contain_trace(t) for t in qids)
-        )
-
     def get_spans_by_trace_ids(self, trace_ids: Sequence[int]
                                ) -> List[List[Span]]:
         if not trace_ids:
@@ -221,16 +205,9 @@ class TieredSpanStore(SpanStore):
         for gid, span in hot.get_trace_rows(trace_ids):
             rows.setdefault(to_signed64(span.trace_id), {})[gid] = span
         t0 = time.perf_counter()
-        for seg in self._cold_segments_for_traces(qids):
-            batch, gids, spans = self.archive.decoded(seg)
-            hit = np.isin(batch.trace_id,
-                          np.fromiter(qids, np.int64, len(qids)))
-            for i in np.flatnonzero(hit):
-                span = spans[int(i)]
-                # Cold copy wins on overlap: captured before any ring
-                # could drop its annotation rows.
-                rows.setdefault(to_signed64(span.trace_id), {})[
-                    int(gids[i])] = span
+        # Cold copy wins on gid overlap: captured before any ring
+        # could drop its annotation rows.
+        self.cold_rows_for_traces(qids, rows)
         self.archive.h_cold_query.observe(time.perf_counter() - t0)
         by_tid = {
             tid: [span for _, span in sorted(found.items())]
@@ -252,15 +229,7 @@ class TieredSpanStore(SpanStore):
             return found
         qids = {to_signed64(t): t for t in missing}
         t0 = time.perf_counter()
-        for seg in self._cold_segments_for_traces(set(qids)):
-            if not qids:
-                break
-            # Exact check on the trace-id column alone — one column's
-            # decompression, no row decode, no decode-cache churn.
-            tid_col = seg.column("trace_id")
-            stids = np.fromiter(qids, np.int64, len(qids))
-            for stid in stids[np.isin(stids, tid_col)]:
-                found.add(qids.pop(int(stid)))
+        found |= self.cold_traces_exist(qids)
         self.archive.h_cold_query.observe(time.perf_counter() - t0)
         return found
 
@@ -274,127 +243,17 @@ class TieredSpanStore(SpanStore):
                                   d.start_timestamp + d.duration]
         canon = {to_signed64(t): t for t in trace_ids}
         t0 = time.perf_counter()
-        stids = np.fromiter(canon, np.int64, len(canon))
-        for seg in self._cold_segments_for_traces(set(canon)):
-            # Column-only read (trace id + ts bounds, no row decode)
-            # with ONE membership pass over the segment; the per-id
-            # min/max then runs on the hit rows only.
-            tid_col = seg.column("trace_id")
-            hit = np.isin(tid_col, stids)
-            if not hit.any():
-                continue
-            tid_hit = tid_col[hit]
-            tsf_hit = seg.column("ts_first")[hit]
-            tsl_hit = seg.column("ts_last")[hit]
-            for stid in np.unique(tid_hit):
-                orig = canon[int(stid)]
-                m = tid_hit == stid
-                tsf = tsf_hit[m]
-                tsl = tsl_hit[m]
-                ts = np.concatenate([tsf[tsf >= 0], tsl[tsl >= 0]])
-                if not ts.size:
-                    continue
-                b = bounds.setdefault(orig, [int(ts.min()),
-                                             int(ts.max())])
-                b[0] = min(b[0], int(ts.min()))
-                b[1] = max(b[1], int(ts.max()))
+        self.cold_duration_bounds(canon, bounds)
         self.archive.h_cold_query.observe(time.perf_counter() - t0)
-        return [
-            TraceIdDuration(t, bounds[t][1] - bounds[t][0], bounds[t][0])
-            for t in trace_ids if t in bounds
-        ]
+        return durations_from_bounds(trace_ids, bounds)
 
-    # -- index reads ----------------------------------------------------
-
-    def _cold_ids_by_name(self, service_name: str,
-                          span_name: Optional[str], end_ts: int,
-                          limit: int) -> List[IndexedTraceId]:
-        dicts = self.hot.dicts
-        svc = dicts.services.get(service_name.lower())
-        if svc is None or limit <= 0:
-            return []
-        name_lc = (dicts.span_names.get(span_name.lower())
-                   if span_name is not None else None)
-        if span_name is not None and name_lc is None:
-            return []
-
-        def probe(seg):
-            z = seg.zone
-            if svc not in z.service_ids or not z.may_match_end_ts(end_ts):
-                return False
-            if name_lc is not None and not z.may_contain_key(
-                    TAG_NAME, svc, name_lc):
-                return False
-            return True
-
-        return self._cold_match(
-            probe,
-            lambda spans: match_spans_by_name(
-                spans, service_name, span_name, end_ts),
-            limit,
-        )
-
-    def _cold_ids_by_annotation(self, service_name: str, annotation: str,
-                                value: Optional[bytes], end_ts: int,
-                                limit: int) -> List[IndexedTraceId]:
-        from zipkin_tpu.models.constants import CORE_ANNOTATIONS
-
-        dicts = self.hot.dicts
-        if annotation in CORE_ANNOTATIONS or limit <= 0:
-            return []
-        svc = dicts.services.get(service_name.lower())
-        if svc is None:
-            return []
-        resolved = resolve_annotation_query(dicts, annotation, value)
-        if resolved is None:
-            return []
-        ann_value, bann_key, bann_value, bann_value2 = resolved
-
-        def probe(seg):
-            z = seg.zone
-            if svc not in z.service_ids or not z.may_match_end_ts(end_ts):
-                return False
-            if value is not None:
-                return any(
-                    v >= 0 and z.may_contain_key(TAG_BVAL, svc,
-                                                 bann_key, v)
-                    for v in (bann_value, bann_value2)
-                )
-            may = False
-            if ann_value >= 0:
-                may = z.may_contain_key(TAG_ANN, svc, ann_value)
-            if not may and bann_key >= 0:
-                may = z.may_contain_key(TAG_BKEY, svc, bann_key)
-            return may
-
-        return self._cold_match(
-            probe,
-            lambda spans: match_spans_by_annotation(
-                spans, service_name, annotation, value, end_ts),
-            limit,
-        )
-
-    def _cold_match(self, probe, matcher, limit: int
-                    ) -> List[IndexedTraceId]:
-        t0 = time.perf_counter()
-        cands = []
-        for seg in self._pruned(probe):
-            _, _, spans = self.archive.decoded(seg)
-            cands.extend(
-                (s.trace_id, s.last_timestamp) for s in matcher(spans)
-                if s.last_timestamp is not None
-            )
-        self.archive.h_cold_query.observe(time.perf_counter() - t0)
-        return dedup_rank_limit(cands, limit)
+    # -- index reads (cold halves come from the ColdQueries mixin) ------
 
     @staticmethod
     def _union(limit: int, *tiers) -> List[IndexedTraceId]:
         """Re-rank the union of per-tier top-``limit`` lists — exact
         (see the module docstring's cross-tier top-k argument)."""
-        return dedup_rank_limit(
-            [(i.trace_id, i.timestamp) for ids in tiers for i in ids],
-            limit,
-        )
+        return union_topk(limit, *tiers)
 
     def get_trace_ids_by_name(self, service_name: str,
                               span_name: Optional[str], end_ts: int,
@@ -449,17 +308,7 @@ class TieredSpanStore(SpanStore):
 
     def get_span_names(self, service: str) -> Set[str]:
         out = self.hot.get_span_names(service)
-        svc = self.hot.dicts.services.get(service.lower())
-        if svc is None:
-            return out
-        for seg in self._pruned(
-                lambda s: svc in s.zone.service_ids):
-            _, _, spans = self.archive.decoded(seg)
-            out.update(
-                s.name for s in match_spans_by_name(
-                    spans, service, None, (1 << 62))
-                if s.name
-            )
+        out.update(self.cold_span_names(service))
         return out
 
     # -- lifetime aggregates (device streaming state; see module doc) ---
@@ -487,36 +336,8 @@ class TieredSpanStore(SpanStore):
     def stored_span_count(self):
         return self.hot.stored_span_count()
 
-    # -- cold-only sketch answers (no row decompression) ----------------
-
-    def cold_duration_quantiles(self, service: str, qs: Sequence[float]
-                                ) -> Optional[List[float]]:
-        """Per-service latency quantiles over CAPTURED spans, answered
-        from segment zone-map histograms alone (same ops.quantile
-        geometry as the device svc_hist)."""
-        svc = self.hot.dicts.services.get(service.lower())
-        if svc is None:
-            return None
-        counts = None
-        for seg in self._segments():
-            row = seg.zone.dur_hist.get(svc)
-            if row is not None:
-                counts = row if counts is None else counts + row
-        if counts is None:
-            return None
-        return quantiles_host(counts, self.params.hist_gamma, 1.0,
-                              list(qs))
-
-    def cold_estimated_unique_traces(self) -> float:
-        """Distinct-trace estimate over the cold tier from merged
-        segment HLLs."""
-        regs = None
-        for seg in self._segments():
-            regs = (seg.zone.hll if regs is None
-                    else SK.hll_merge(regs, seg.zone.hll))
-        if regs is None:
-            return 0.0
-        return SK.hll_estimate(regs)
+    # -- cold-only sketch answers: cold_duration_quantiles /
+    # cold_estimated_unique_traces come from the ColdQueries mixin ------
 
     # -- telemetry ------------------------------------------------------
 

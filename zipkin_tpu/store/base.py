@@ -145,6 +145,12 @@ class PinBank:
         return list(bank) + [s for s in ring_spans if s.id not in seen_ids]
 
 
+# Bound on a store's host TTL map (pins + recent traces); ring/segment
+# eviction has no host-side hook, so pruning happens on insert. Shared
+# by the device, sharded, and replica stores.
+MAX_TTL_ENTRIES = 1 << 20
+
+
 def prune_ttls(ttls: dict, max_entries: int) -> None:
     """Drop oldest non-pinned TTL entries beyond the bound (ring
     eviction is the real retention; pinned entries — ttl > 1.0 —

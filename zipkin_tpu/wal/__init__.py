@@ -25,13 +25,18 @@ from zipkin_tpu.wal.log import (
     WriteAheadLog,
 )
 from zipkin_tpu.wal.record import WalReplayError
-from zipkin_tpu.wal.recovery import recover, replay_into
+from zipkin_tpu.wal.recovery import (
+    apply_record_into,
+    recover,
+    replay_into,
+)
 
 __all__ = [
     "FsyncPolicy",
     "WalDurabilityError",
     "WriteAheadLog",
     "WalReplayError",
+    "apply_record_into",
     "recover",
     "replay_into",
 ]

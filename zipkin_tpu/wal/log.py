@@ -47,6 +47,17 @@ records are all covered by a checkpoint (checkpoint.save calls it with
 the manifest's applied sequence once the snapshot is durably in
 place); the active segment is rolled first when fully covered, so
 steady-state disk is one checkpoint plus the post-checkpoint tail.
+
+Shipping-aware retention (docs/REPLICATION.md). A log being shipped to
+followers must not truncate records a registered follower has not yet
+fetched: ``register_cursor(name, seq)`` pins the truncation frontier
+at the minimum registered cursor (``advance_cursor`` moves it as the
+follower acks, ``drop_cursor`` releases it), and ``retain_bytes``
+keeps at least that many newest bytes of checkpoint-covered tail on
+disk regardless — so a follower that reconnects shortly after a
+checkpoint can still catch up from the log instead of needing an
+anchor bootstrap. An un-pinned log with retain_bytes=0 truncates
+exactly as before.
 """
 
 from __future__ import annotations
@@ -216,6 +227,7 @@ class WriteAheadLog:
                  interval_s: float = 0.05,
                  segment_bytes: int = 64 << 20,
                  compress: bool = True,
+                 retain_bytes: int = 0,
                  registry=None):
         from zipkin_tpu import obs
 
@@ -229,7 +241,15 @@ class WriteAheadLog:
         self.interval_s = max(1e-3, float(interval_s))
         self.segment_bytes = max(1 << 12, int(segment_bytes))
         self.compress = compress
+        # Shipping retention floor: keep at least this many newest
+        # bytes of checkpoint-covered tail (0 = truncate everything
+        # covered, the pre-replication behavior).
+        self.retain_bytes = max(0, int(retain_bytes))
         self._cond = threading.Condition()  # lock-order: 60 wal
+        # Registered follower cursors: name -> highest fetched seq.
+        # truncate() never deletes a segment holding records past the
+        # minimum cursor (the shipping retention pin).
+        self._cursors: dict = {}  # guarded-by: _cond
         self._segments: List[_Segment] = []  # guarded-by: _cond
         self._file = None  # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
@@ -357,6 +377,40 @@ class WriteAheadLog:
         'batch' and 'off' policies)."""
         with self._cond:
             return self._durable
+
+    def first_available_seq(self) -> int:
+        """Lowest sequence the log can still replay (truncation may
+        have deleted earlier records). ``last_seq + 1`` when the log
+        holds no records — a follower whose cursor is at or past
+        ``first_available_seq() - 1`` can catch up from the log alone;
+        anything older needs an anchor bootstrap (replicate/ship)."""
+        with self._cond:
+            for seg in self._segments:
+                if seg.n_records:
+                    return seg.base_seq
+            return self._next_seq
+
+    # -- follower cursors (shipping retention pins) ---------------------
+
+    def register_cursor(self, name: str, seq: int = 0) -> None:
+        """Pin truncation at ``seq``: segments holding records past the
+        minimum registered cursor survive truncate() until the cursor
+        advances. Re-registering moves the pin (monotonically — a
+        follower can never un-fetch)."""
+        with self._cond:
+            have = self._cursors.get(name, -1)
+            self._cursors[name] = max(have, int(seq))
+
+    def advance_cursor(self, name: str, seq: int) -> None:
+        self.register_cursor(name, seq)
+
+    def drop_cursor(self, name: str) -> None:
+        with self._cond:
+            self._cursors.pop(name, None)
+
+    def cursors(self) -> dict:
+        with self._cond:
+            return dict(self._cursors)
 
     # -- append path ----------------------------------------------------
 
@@ -565,11 +619,19 @@ class WriteAheadLog:
             if seg.last_seq <= from_seq:
                 continue
             n_seen = 0
-            for i, payload, _off in _iter_records(seg.path):
-                n_seen = i + 1
-                seq = seg.base_seq + i
-                if seq > from_seq:
-                    yield seq, payload
+            try:
+                for i, payload, _off in _iter_records(seg.path):
+                    n_seen = i + 1
+                    seq = seg.base_seq + i
+                    if seq > from_seq:
+                        yield seq, payload
+            except FileNotFoundError:
+                # A concurrent truncate() deleted the file after the
+                # snapshot (possible only for already-covered,
+                # un-pinned segments — shipping readers pin theirs):
+                # stop at the prefix served so far; the caller's next
+                # replay(from_seq) resumes past the hole.
+                return
             if n_seen < seg.n_records:
                 self.c_corrupt.inc(seg.n_records - n_seen)
                 return
@@ -585,10 +647,18 @@ class WriteAheadLog:
     def truncate(self, upto_seq: int) -> int:
         """Delete whole segments fully covered by ``upto_seq`` (a
         checkpoint's applied frontier). The active segment rolls first
-        when fully covered so its file can go too. Returns the number
-        of segment files deleted."""
+        when fully covered so its file can go too. Registered follower
+        cursors clamp the frontier (a shipped log never deletes what a
+        follower still has to fetch) and ``retain_bytes`` keeps the
+        newest covered tail on disk. Returns the number of segment
+        files deleted."""
         removed = 0
         with self._cond:
+            # Follower pin: records past the minimum cursor are not
+            # yet fetched — truncation must stop below them no matter
+            # what the checkpoint covers.
+            if self._cursors:
+                upto_seq = min(upto_seq, min(self._cursors.values()))
             # Roll BEFORE deleting whenever the newest record-bearing
             # segment is covered — even on a reopened log that has not
             # appended yet (file not open). Deleting every segment
@@ -601,12 +671,25 @@ class WriteAheadLog:
                     and self._segments[-1].n_records > 0
                     and self._segments[-1].last_seq <= upto_seq):
                 self._roll_locked()
+            # Byte floor: walking from the newest segment, everything
+            # inside the retain_bytes window survives even when
+            # checkpoint-covered (reconnecting followers catch up from
+            # the log instead of re-anchoring).
+            protected: set = set()
+            if self.retain_bytes > 0:
+                tail = 0
+                for seg in reversed(self._segments):
+                    if tail >= self.retain_bytes:
+                        break
+                    protected.add(seg.base_seq)
+                    tail += seg.nbytes
             keep: List[_Segment] = []
             for seg in self._segments:
                 is_active = (self._file is not None
                              and seg is self._segments[-1])
                 if (not is_active and seg.n_records > 0
-                        and seg.last_seq <= upto_seq):
+                        and seg.last_seq <= upto_seq
+                        and seg.base_seq not in protected):
                     self._delete_segment(seg.path)
                     removed += 1
                 else:

@@ -35,6 +35,41 @@ from zipkin_tpu.wal.record import (
 )
 
 
+def pin_tids_of(hot) -> Optional[np.ndarray]:
+    """Pinned trace ids as an int64 array (None when the bank is
+    empty) — snapshot once per replay/ship session, like live ingest's
+    write_thrift pin path."""
+    return (np.fromiter(hot.pins.tids(), np.int64,
+                        len(hot.pins.tids()))
+            if hot.pins else None)
+
+
+def apply_record_into(hot, seq: int, payload: bytes,
+                      pin_tids: Optional[np.ndarray] = None) -> int:
+    """Drive ONE journaled record through the store's normal commit
+    body (``_commit_unit``) — the single replay step shared by crash
+    recovery and the warm-standby follower (replicate/follow), so a
+    standby replays bit-for-bit the way a recovering primary does.
+    Returns the unit's span count."""
+    group, before, deltas = decode_unit(payload)
+    apply_dict_deltas(hot.dicts, before, deltas)
+    unit = hot._pad_unit(group)._replace(wal_seq=seq)
+    with hot._lock:
+        for batch, _lc, _ix in group:
+            for tid in np.unique(batch.trace_id):
+                hot.ttls.setdefault(int(tid), 1.0)
+            if pin_tids is not None and len(pin_tids):
+                keep = np.isin(batch.trace_id, pin_tids)
+                if keep.any():
+                    pinned = hot._select_batch(batch, keep)
+                    hot._bump_read_epoch()
+                    hot.pins.note_write(
+                        to_signed64, hot.codec.decode(pinned))
+        hot._prune_ttls()
+        hot._commit_unit(unit)
+    return unit.n_spans
+
+
 def replay_into(store, wal, from_seq: Optional[int] = None) -> dict:
     """Replay every WAL record with seq > ``from_seq`` (default: the
     store's restored applied frontier) through the normal ingest path.
@@ -52,29 +87,11 @@ def replay_into(store, wal, from_seq: Optional[int] = None) -> dict:
     # would (write_thrift's columnar pin path) — otherwise replayed
     # spans of a pinned trace would live only in the volatile ring and
     # vanish once it laps.
-    pin_tids = (np.fromiter(hot.pins.tids(), np.int64,
-                            len(hot.pins.tids()))
-                if hot.pins else None)
+    pin_tids = pin_tids_of(hot)
     for seq, payload in wal.replay(from_seq):
-        group, before, deltas = decode_unit(payload)
-        apply_dict_deltas(hot.dicts, before, deltas)
-        unit = hot._pad_unit(group)._replace(wal_seq=seq)
-        with hot._lock:
-            for batch, _lc, _ix in group:
-                for tid in np.unique(batch.trace_id):
-                    hot.ttls.setdefault(int(tid), 1.0)
-                if pin_tids is not None and len(pin_tids):
-                    keep = np.isin(batch.trace_id, pin_tids)
-                    if keep.any():
-                        pinned = hot._select_batch(batch, keep)
-                        hot._bump_read_epoch()
-                        hot.pins.note_write(
-                            to_signed64, hot.codec.decode(pinned))
-            hot._prune_ttls()
-            hot._commit_unit(unit)
+        n_spans += apply_record_into(hot, seq, payload, pin_tids)
         wal.c_replayed.inc()
         n_records += 1
-        n_spans += unit.n_spans
     # Future appends journal deltas from the replayed high-water marks.
     hot._wal_marks = dict_sizes(hot.dicts)
     return {
