@@ -43,6 +43,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -1472,6 +1473,146 @@ def bench_replication(total_spans: int = 100_000, n_replicas: int = 3):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_multichip(total_spans: int = 200_000,
+                    n_shards: Optional[int] = None):
+    """Multi-chip sharded serving phase (r16 tentpole,
+    zipkin_tpu.parallel.shard): what the fleet buys over one chip.
+    One span stream is driven through (a) a single-device store and
+    (b) an N-shard ``ShardedSpanStore`` over the same per-shard
+    geometry — spans/s per chip and scaling efficiency come straight
+    from the pair. The read side measures aggregate queries/s under
+    concurrent API load twice: serialized (one reader, one collective
+    launch per query — the pre-dispatcher deployment) vs batched
+    (eight readers through the cross-shard dispatcher, one launch per
+    micro-window), with bitwise-identical answers required, plus the
+    launch count the dispatcher saved. On the CPU harness the
+    absolute rates are trend numbers; the scaling ratio, the launch
+    arithmetic, and the identity bits are the portable evidence."""
+    import threading
+
+    import jax
+
+    from zipkin_tpu.parallel.shard import ShardedSpanStore
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"skipped": f"needs >=2 devices, have {len(devs)}"}
+    from jax.sharding import Mesh
+
+    n = n_shards or min(len(devs), 8)
+    cap = 1 << max(12, total_spans.bit_length() - 2)
+    config = dev.StoreConfig(
+        capacity=cap, ann_capacity=4 * cap, bann_capacity=2 * cap,
+        max_services=64, max_span_names=256,
+        max_annotation_values=512, max_binary_keys=64,
+        cms_width=1 << 12, hll_p=10, quantile_buckets=512,
+    )
+    _log(f"multichip phase: {total_spans} spans, {n} shards")
+    spans = []
+    while len(spans) < total_spans:
+        spans.extend(
+            s for t in generate_traces(
+                n_traces=max(total_spans // 10, 64), max_depth=3,
+                n_services=32,
+            ) for s in t
+        )
+    spans = spans[:total_spans]
+    chunk = 2048
+
+    def stream(store):
+        # First chunk warms the compile; timed from the second on.
+        store.apply(spans[:chunk])
+        t0 = time.perf_counter()
+        for i in range(chunk, len(spans), chunk):
+            store.apply(spans[i:i + chunk])
+        return (len(spans) - chunk) / (time.perf_counter() - t0)
+
+    single = TpuSpanStore(config)
+    single_rate = stream(single)
+    del single
+
+    mesh = Mesh(np.array(devs[:n]), axis_names=("shard",))
+    fleet = ShardedSpanStore(mesh, config, dispatch_window_s=0.004)
+    try:
+        fleet_rate = stream(fleet)
+        with fleet.pipelined(depth=8):
+            t0 = time.perf_counter()
+            for i in range(0, len(spans), chunk):
+                fleet.apply(spans[i:i + chunk])
+        piped_rate = len(spans) / (time.perf_counter() - t0)
+
+        # Read side: the same mixed query set, serialized then batched.
+        svcs = sorted(fleet.get_all_service_names())[:8]
+        end_ts = 2**62
+        queries = [("q", svc) if i % 2 else ("ids", svc)
+                   for i, svc in enumerate(svcs * 8)]
+
+        def run_one(kind, svc):
+            if kind == "q":
+                return fleet.service_duration_quantiles(svc, [0.5, 0.99])
+            return [(r.trace_id, r.timestamp)
+                    for r in fleet.get_trace_ids_by_name(
+                        svc, None, end_ts, 10)]
+
+        for kind, svc in queries[:len(svcs) * 2]:
+            run_one(kind, svc)  # warm both kernel families
+        fleet.dispatcher.drain()
+
+        launches0 = fleet.collective_launches()
+        t0 = time.perf_counter()
+        serialized = [run_one(*q) for q in queries]
+        serial_s = time.perf_counter() - t0
+        serial_launches = fleet.collective_launches() - launches0
+
+        n_threads = 8
+        per = len(queries) // n_threads
+        batched: list = [None] * len(queries)
+        barrier = threading.Barrier(n_threads + 1)
+
+        def reader(t_idx):
+            barrier.wait()
+            for j in range(t_idx * per, (t_idx + 1) * per):
+                batched[j] = run_one(*queries[j])
+
+        threads = [threading.Thread(target=reader, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        launches0 = fleet.collective_launches()
+        t0 = time.perf_counter()
+        barrier.wait()
+        for t in threads:
+            t.join()
+        batched_s = time.perf_counter() - t0
+        batched_launches = fleet.collective_launches() - launches0
+        dstats = fleet.dispatcher.stats()
+
+        return {
+            "shards": n,
+            "spans": total_spans,
+            "single_chip_spans_per_s": round(single_rate, 1),
+            "fleet_spans_per_s": round(fleet_rate, 1),
+            "fleet_pipelined_spans_per_s": round(piped_rate, 1),
+            "fleet_spans_per_s_per_chip": round(fleet_rate / n, 1),
+            "scaling_efficiency": round(
+                fleet_rate / (single_rate * n), 3),
+            "queries": len(queries),
+            "serialized_qps": round(len(queries) / serial_s, 1),
+            "batched_qps": round(len(queries) / batched_s, 1),
+            "read_speedup": round(serial_s / batched_s, 2),
+            "serialized_launches": int(serial_launches),
+            "batched_launches": int(batched_launches),
+            "dispatcher_launches_saved": dstats["launches_saved"],
+            "dispatcher_max_batch": dstats["max_batch"],
+            "answers_identical": serialized == batched,
+        }
+    finally:
+        fleet.close()
+
+
 def bench_checkpoint(store):
     """Checkpoint at bench scale (VERDICT r3 item 8): snapshot the
     streamed store, restore it, and require bit-identical answers to a
@@ -1911,6 +2052,17 @@ def main():
             timeout_s=900, label="replication")
         emit("stream+queries+exactness+archive+pipeline+durability"
              "+windows+replication")
+        # Multi-chip sharded serving (r16 tentpole, parallel/shard):
+        # spans/s-per-chip scaling vs one chip, aggregate read q/s
+        # serialized vs dispatcher-batched with the launch counts and
+        # the bitwise-identity bit. Skips itself (one JSON key) on a
+        # single-device backend; bounded like its neighbors.
+        detail["multichip"] = _bounded(
+            lambda: bench_multichip(
+                int(2e4) if args.smoke else int(2e5)),
+            timeout_s=900, label="multichip")
+        emit("stream+queries+exactness+archive+pipeline+durability"
+             "+windows+replication+multichip")
         # Ingest roofline round 2 (r12 tentpole): spans/s per
         # (batch_spans, sort-path, scatter-path) arm — the evidence
         # the batch-escalation knee and the >=300k spans/s cert read

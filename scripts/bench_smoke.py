@@ -1014,6 +1014,159 @@ def run_replication() -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_sharded() -> dict:
+    """Multi-chip sharded-serving phase (r16 tentpole): a 2-shard
+    fleet on the host-simulated mesh must (a) fuse concurrent API
+    reads through the cross-shard dispatcher into collective launches
+    whose answers are BITWISE identical to serialized execution, (b)
+    serve that burst with ZERO jit recompiles (the mapped kernels are
+    resident; the dispatcher only changes who launches them), and (c)
+    answer the fleet sketch tier bitwise against a single-device
+    oracle fed the same spans — name-aligned histogram rows (the two
+    codecs may assign dictionary ids in different orders; values per
+    service must still match exactly) and identical HLL registers."""
+    import threading
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from zipkin_tpu.parallel.shard import ShardedSpanStore
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import generate_traces
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        # Standalone invocation on a true single-device backend: the
+        # tier-1 lane always has the 8-device virtual mesh (conftest
+        # exports XLA_FLAGS before spawning this script).
+        return {"skipped": "single-device backend"}
+    mesh = Mesh(np.array(devs[:2]), axis_names=("shard",))
+    config = dev.StoreConfig(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=64, max_span_names=128, max_annotation_values=512,
+        max_binary_keys=128, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=128,
+    )
+    spans = [
+        s for t in generate_traces(n_traces=48, max_depth=3,
+                                   n_services=16,
+                                   rng=np.random.default_rng(16))
+        for s in t
+    ]
+    # A generous micro-window: every barrier-released reader must land
+    # in ONE batch even on a loaded CI host (the launch-count gate in
+    # tests/test_bench_smoke.py rides on it); production deployments
+    # run single-digit-ms windows (main/example.py --query-window-ms).
+    store = ShardedSpanStore(mesh, config, dispatch_window_s=0.5)
+    single = TpuSpanStore(config)
+    try:
+        t0 = time.perf_counter()
+        store.apply(spans)
+        ingest_s = time.perf_counter() - t0
+        single.apply(spans)
+        svcs = sorted(store.get_all_service_names())[:4]
+        end_ts = 2**62
+
+        # Warm every kernel the burst hits, then drain the window so
+        # the recompile/launch deltas below measure steady state only.
+        for svc in svcs:
+            store.service_duration_quantiles(svc, [0.5, 0.99])
+            store.get_trace_ids_by_name(svc, None, end_ts, 10)
+        store.get_trace_ids_multi(
+            [("name", svc, None, end_ts, 10) for svc in svcs])
+        store.dispatcher.drain()
+
+        barrier = threading.Barrier(9)
+        results: dict = {}
+        errors: list = []
+
+        def cat_worker(i, svc):
+            try:
+                barrier.wait()
+                results[i] = store.service_duration_quantiles(
+                    svc, [0.5, 0.99])
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        def ids_worker(i, svc):
+            try:
+                barrier.wait()
+                results[i] = [
+                    (r.trace_id, r.timestamp)
+                    for r in store.get_trace_ids_by_name(
+                        svc, None, end_ts, 10)]
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        threads = (
+            [threading.Thread(target=cat_worker, args=(i, svcs[i]))
+             for i in range(4)]
+            + [threading.Thread(target=ids_worker, args=(4 + i, svcs[i]))
+               for i in range(4)]
+        )
+        for t in threads:
+            t.start()
+        compiles0 = dev.compile_count()
+        launches0 = store.collective_launches()
+        t0 = time.perf_counter()
+        barrier.wait()
+        for t in threads:
+            t.join(timeout=120.0)
+        burst_s = time.perf_counter() - t0
+        burst_launches = store.collective_launches() - launches0
+        recompiles = dev.compile_count() - compiles0
+
+        # Serialized identity: each query re-issued alone must answer
+        # exactly what it answered inside the fused burst.
+        identical = not errors and all(
+            results[i] == store.service_duration_quantiles(
+                svcs[i], [0.5, 0.99])
+            for i in range(4)
+        ) and all(
+            results[4 + i] == [
+                (r.trace_id, r.timestamp)
+                for r in store.get_trace_ids_by_name(
+                    svcs[i], None, end_ts, 10)]
+            for i in range(4)
+        )
+
+        # Fleet sketch tier vs the single-device oracle, name-aligned.
+        fleet = store.ensure_sketch_mirror()
+        oracle = single.ensure_sketch_mirror()
+        names = sorted(single.get_all_service_names())
+        rows_ok = bool(names) and all(
+            np.array_equal(
+                fleet.hist_row(store.dicts.services.get(n)),
+                oracle.hist_row(single.dicts.services.get(n)))
+            for n in names
+        )
+        hll_ok = np.array_equal(fleet.hll_registers(),
+                                oracle.hll_registers())
+        names_ok = set(names) == set(store.get_all_service_names())
+
+        dstats = store.dispatcher.stats()
+        return {
+            "shards": store.n,
+            "spans": len(spans),
+            "ingest_spans_per_s": round(len(spans) / ingest_s, 1),
+            "burst_reads": 8,
+            "burst_ms": round(burst_s * 1e3, 2),
+            "burst_launches": int(burst_launches),
+            "steady_state_recompiles": int(recompiles),
+            "dispatcher_batches": dstats["batches"],
+            "dispatcher_launches_saved": dstats["launches_saved"],
+            "identical": bool(identical),
+            "errors": errors[:4],
+            "fleet_hist_rows_bitwise": bool(rows_ok),
+            "fleet_hll_bitwise": bool(hll_ok),
+            "service_names_identical": bool(names_ok),
+        }
+    finally:
+        store.close()
+
+
 def run_lint() -> dict:
     """graftlint phase (tier-1 gated): the concurrency/JAX-hazard
     analyzer (zipkin_tpu/analysis, docs/STATIC_ANALYSIS.md) over the
@@ -1164,6 +1317,7 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         "ingest_structure": run_ingest_structure(),
         "windows": run_windows(),
         "replication": run_replication(),
+        "sharded": run_sharded(),
         "lint": run_lint(),
         # The main stream runs the library default (window arena OFF),
         # so its step census gates at the BASE ceilings; the windows

@@ -41,6 +41,8 @@ _SLOW_TESTS = {
     "test_tracegen_main_tpu_roundtrip",
     "test_pinned_traces_survive_checkpoint_restart",
     "test_sharded_checkpoint_roundtrip",
+    "test_sharded_checkpoint_wal_tail_recovery",
+    "test_sharded_pipelined_ingest_bitwise_matches_serial",
     "test_sharded_legacy_snapshot_migrates",
     "test_dependencies_honor_time_window",
     "test_sharded_dependencies_window",

@@ -1,0 +1,41 @@
+"""FP twin: the sanctioned launch shapes stay silent — a read-mode
+hold WITH the collective-launch leaf lock, a write-mode hold (writers
+exclude each other, no concurrent dispatch), and unlocked launches."""
+import threading
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+
+def _step(states):
+    return states
+
+
+class RWLock:
+    pass
+
+
+class Fleet:
+    def __init__(self):
+        self._rw = RWLock()  # lock-order: 40 commit
+        self._coll_lock = threading.Lock()  # lock-order: 45 collective-launch
+        self._sum_kernel = jax.jit(shard_map(_step, mesh=None,
+                                             in_specs=None,
+                                             out_specs=None))
+        self.states = None
+
+    def good_serialized_read(self):
+        with self._rw.read():
+            with self._coll_lock:
+                return self._sum_kernel(self.states)
+
+    def good_write_hold(self):
+        with self._rw.write():
+            return self._sum_kernel(self.states)
+
+    def good_unlocked(self):
+        return self._sum_kernel(self.states)
+
+    def good_host_work_under_read(self):
+        with self._rw.read():
+            return len(self.states)

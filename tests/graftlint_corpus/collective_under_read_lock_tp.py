@@ -1,0 +1,46 @@
+"""TP: shard_map/pjit collective launches under a shared READ lock
+with no collective-launch leaf held — concurrent readers would
+dispatch overlapping collectives into the cross-device rendezvous."""
+import threading
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.experimental.pjit import pjit
+
+
+def _step(states):
+    return states
+
+
+_FLEET_SUM = jax.jit(shard_map(_step, mesh=None,
+                               in_specs=None, out_specs=None))
+
+
+class RWLock:
+    pass
+
+
+class Fleet:
+    def __init__(self):
+        self._rw = RWLock()  # lock-order: 40 commit
+        self._sum_kernel = shard_map(_step, mesh=None,
+                                     in_specs=None, out_specs=None)
+        self.states = None
+
+    def bad_self_attr(self):
+        with self._rw.read():
+            return self._sum_kernel(self.states)
+
+    def bad_module_kernel(self):
+        with self._rw.read():
+            return _FLEET_SUM(self.states)
+
+    def bad_local_alias(self):
+        kern = pjit(_step)
+        with self._rw.read():
+            return kern(self.states)
+
+    def bad_inline(self):
+        with self._rw.read():
+            return shard_map(_step, mesh=None, in_specs=None,
+                             out_specs=None)(self.states)

@@ -207,6 +207,27 @@ def test_bench_smoke_json_and_op_ceilings():
     assert rep["shipped_bytes"] > 0, rep
     assert rep["replica_sketch_p50_ms"] < 10.0, rep
     assert rep["follower_cursor_pinned"] is True, rep
+    # Sharded-serving phase (r16 tentpole): a 2-shard fleet on the
+    # virtual mesh must fuse a barrier-released burst of 8 concurrent
+    # reads through the cross-shard dispatcher into AT MOST the two
+    # collective launches the design budgets (one fused catalog
+    # bundle + one multi-probe kernel), answer them BITWISE identical
+    # to serialized re-execution, add ZERO jit recompiles in steady
+    # state (the mapped kernels stay resident; batching only changes
+    # who launches them), and answer the fleet sketch tier bitwise
+    # against a single-device oracle fed the same spans (name-aligned
+    # histogram rows + identical HLL registers).
+    sh = rec["sharded"]
+    assert "skipped" not in sh, sh
+    assert sh["shards"] == 2, sh
+    assert sh["identical"] is True, sh
+    assert sh["errors"] == [], sh
+    assert sh["burst_launches"] <= 2, sh
+    assert sh["steady_state_recompiles"] == 0, sh
+    assert sh["dispatcher_launches_saved"] >= 6, sh
+    assert sh["fleet_hist_rows_bitwise"] is True, sh
+    assert sh["fleet_hll_bitwise"] is True, sh
+    assert sh["service_names_identical"] is True, sh
     # graftlint phase (this PR's tentpole): the concurrency/JAX-hazard
     # analyzer must cover the whole package, find ZERO findings not in
     # the checked-in baseline, and stay inside its 30s budget (the

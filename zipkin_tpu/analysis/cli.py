@@ -29,6 +29,7 @@ from zipkin_tpu.analysis.rules_guard import (
     suggest_annotations,
 )
 from zipkin_tpu.analysis.rules_jax import (
+    check_collective_read_lock,
     check_jit_rules,
     check_use_after_donate,
 )
@@ -52,6 +53,7 @@ _CHECKS = (
     check_sync_under_lock,
     check_jit_rules,
     check_use_after_donate,
+    check_collective_read_lock,
     check_swallowed,
 )
 

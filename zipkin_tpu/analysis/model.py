@@ -47,11 +47,12 @@ JIT_TRACED_BRANCH = "jit-traced-branch"
 JIT_NONSTATIC_CLOSURE = "jit-nonstatic-closure"
 USE_AFTER_DONATE = "use-after-donate"
 SWALLOWED_EXCEPTION = "swallowed-exception"
+COLLECTIVE_UNDER_READ_LOCK = "collective-under-read-lock"
 
 ALL_RULES = (
     LOCK_ORDER, LOCK_CYCLE, UNANNOTATED_LOCK, GUARDED_BY, CALLED_UNDER,
     SYNC_UNDER_LOCK, JIT_TRACED_BRANCH, JIT_NONSTATIC_CLOSURE,
-    USE_AFTER_DONATE, SWALLOWED_EXCEPTION,
+    USE_AFTER_DONATE, SWALLOWED_EXCEPTION, COLLECTIVE_UNDER_READ_LOCK,
 )
 
 

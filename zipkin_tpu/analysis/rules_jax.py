@@ -12,10 +12,18 @@
 - ``use-after-donate`` — an argument passed in a ``donate_argnums``
   position is read again after the call without being rebound: its
   device buffer was donated and may already be freed/reused.
+- ``collective-under-read-lock`` — launching a shard_map/pjit-built
+  kernel while holding an RWLock in READ mode without also holding a
+  lock flagged ``collective-launch`` (``# lock-order: 45
+  collective-launch``). Concurrent read-mode holders run in parallel,
+  so two of them dispatching collectives concurrently deadlock XLA's
+  CPU cross-device rendezvous — the r14 hazard the sharded store's
+  ``_coll_lock`` (and above it the cross-shard dispatcher,
+  parallel/dispatch.py) exists to serialize.
 
-All three are intentionally narrow heuristics (fixture-corpus-pinned in
-tests/test_analysis.py); anything subtler belongs in review, not in a
-gate that must never cry wolf.
+All of these are intentionally narrow heuristics (fixture-corpus-pinned
+in tests/test_analysis.py); anything subtler belongs in review, not in
+a gate that must never cry wolf.
 """
 
 from __future__ import annotations
@@ -25,6 +33,7 @@ import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from zipkin_tpu.analysis.model import (
+    COLLECTIVE_UNDER_READ_LOCK,
     Finding,
     JIT_NONSTATIC_CLOSURE,
     JIT_TRACED_BRANCH,
@@ -325,6 +334,221 @@ class _DonateScanner:
                             "rebind the result or copy first"),
                         detail=f"{self.scope}|{e}"))
                     self.donated.pop(e, None)
+
+
+# -- collective-under-read-lock -------------------------------------------
+
+# Callables whose result is a cross-device collective program: calling
+# it dispatches a launch that must rendezvous with every other device.
+_COLLECTIVE_CTORS = {"shard_map", "compat_shard_map", "pjit"}
+
+
+def _builds_collective(value: Optional[ast.AST]) -> bool:
+    """True when ``value`` contains a shard_map/pjit constructor call
+    anywhere in its wrapper chain (``jax.jit(shard_map(...))``
+    included)."""
+    if value is None:
+        return False
+    for node in ast.walk(value):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if name in _COLLECTIVE_CTORS:
+                return True
+    return False
+
+
+def _collective_registry(tree: ast.Module) -> Tuple[Set[str],
+                                                    Dict[str, Set[str]]]:
+    """(module-level kernel names, class name -> self-attr kernel
+    names): every name/attr assigned a collective program anywhere in
+    the file."""
+    mod_kernels: Set[str] = set()
+    cls_kernels: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and _builds_collective(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    mod_kernels.add(t.id)
+        elif isinstance(node, ast.ClassDef):
+            attrs: Set[str] = set()
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Assign)
+                        and _builds_collective(sub.value)):
+                    continue
+                for t in sub.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        attrs.add(t.attr)
+            if attrs:
+                cls_kernels[node.name] = attrs
+    return mod_kernels, cls_kernels
+
+
+class _CollectiveScanner:
+    """Lexical walk of one function body: held-lock stack (the
+    _FuncScanner discipline) + collective-launch detection. A launch is
+    a call of a registered kernel name/self-attr, or an immediate
+    ``shard_map(...)(args)``. Flags launches inside a read-mode RWLock
+    hold with no held lock carrying the ``collective-launch`` flag."""
+
+    def __init__(self, project: Project, module, scope: str,
+                 mod_kernels: Set[str], attr_kernels: Set[str]):
+        self.project = project
+        self.module = module
+        self.scope = scope
+        self.mod_kernels = mod_kernels
+        self.attr_kernels = attr_kernels
+        self.local_kernels: Set[str] = set()
+        self.lock_attrs = set(project.locks_by_attr)
+        self.held: List[Tuple[str, Optional[str]]] = []
+        self.findings: List[Finding] = []
+        self.seen: Set[str] = set()
+
+    def _lock_ref(self, expr: ast.AST) -> Optional[Tuple[str,
+                                                         Optional[str]]]:
+        if (isinstance(expr, ast.Call) and not expr.args
+                and isinstance(expr.func, ast.Attribute)
+                and expr.func.attr in ("read", "write")):
+            inner = expr.func.value
+            if (isinstance(inner, ast.Attribute)
+                    and inner.attr in self.lock_attrs):
+                return inner.attr, expr.func.attr
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and expr.attr in self.lock_attrs):
+            return expr.attr, None
+        if isinstance(expr, ast.Name) and expr.id in self.lock_attrs:
+            return expr.id, None
+        return None
+
+    def _launch_safe(self) -> bool:
+        """True when some held lock is flagged ``collective-launch``."""
+        for attr, _mode in self.held:
+            for d in self.project.locks_by_attr.get(attr, ()):
+                if "collective-launch" in d.flags:
+                    return True
+        return False
+
+    def _kernel_name(self, func: ast.AST) -> Optional[str]:
+        if isinstance(func, ast.Name) and func.id in (
+                self.mod_kernels | self.local_kernels):
+            return func.id
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and func.attr in self.attr_kernels):
+            return f"self.{func.attr}"
+        if isinstance(func, ast.Call) and _builds_collective(func):
+            return "<inline-collective>"
+        return None
+
+    def _stmts(self, body) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # different execution context
+        if isinstance(stmt, ast.With):
+            refs = []
+            for item in stmt.items:
+                r = self._lock_ref(item.context_expr)
+                if r is not None:
+                    refs.append(r)
+                else:
+                    self._expr(item.context_expr)
+            self.held.extend(refs)
+            self._stmts(stmt.body)
+            if refs:
+                del self.held[-len(refs):]
+            return
+        if isinstance(stmt, ast.Assign):
+            # kern = shard_map(...) / kern = self._kernel_attr
+            if _builds_collective(stmt.value) or (
+                    isinstance(stmt.value, ast.Attribute)
+                    and isinstance(stmt.value.value, ast.Name)
+                    and stmt.value.value.id == "self"
+                    and stmt.value.attr in self.attr_kernels):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        self.local_kernels.add(t.id)
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+            return
+        self._expr(stmt)
+
+    def _expr(self, node: ast.AST) -> None:
+        for sub in [node] + list(_walk_pruned(node)):
+            if not isinstance(sub, ast.Call):
+                continue
+            kern = self._kernel_name(sub.func)
+            if kern is None:
+                continue
+            read_hold = next(
+                (a for a, m in self.held if m == "read"), None)
+            if read_hold is None or self._launch_safe():
+                continue
+            if kern in self.seen:
+                continue
+            self.seen.add(kern)
+            self.findings.append(Finding(
+                rule=COLLECTIVE_UNDER_READ_LOCK, path=self.module.path,
+                line=sub.lineno, scope=self.scope,
+                message=(
+                    f"collective launch {kern}(...) under the shared "
+                    f"read lock {read_hold} without a collective-"
+                    "launch leaf lock — concurrent readers would "
+                    "dispatch overlapping collectives and deadlock "
+                    "the cross-device rendezvous; hold the "
+                    "'# lock-order: 45 collective-launch' lock (or "
+                    "route through the cross-shard dispatcher)"),
+                detail=f"{self.scope}|{kern}"))
+
+
+def check_collective_read_lock(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for m in project.modules:
+        tree = _parse(project, m)
+        if tree is None:
+            continue
+        mod_kernels, cls_kernels = _collective_registry(tree)
+        if not mod_kernels and not cls_kernels and (
+                "shard_map" not in m.from_imports
+                and "pjit" not in m.from_imports):
+            continue
+
+        def scan(fn, scope, attr_kernels):
+            s = _CollectiveScanner(project, m, scope,
+                                   mod_kernels, attr_kernels)
+            s._stmts(fn.body)
+            out.extend(s.findings)
+
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                scan(node, node.name, set())
+            elif isinstance(node, ast.ClassDef):
+                attrs = cls_kernels.get(node.name, set())
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        scan(sub, f"{node.name}.{sub.name}", attrs)
+    return out
 
 
 def check_use_after_donate(project: Project) -> List[Finding]:

@@ -146,6 +146,19 @@ class ApiServer:
                 self.registry.register(obs.Gauge(
                     f"zipkin_query_coalesce_{attr}", help_,
                     fn=(lambda a=attr: getattr(coal, a))))
+        disp = getattr(query.store, "dispatcher", None)
+        if disp is not None:
+            for attr, help_ in (
+                ("batches", "Cross-shard dispatcher batches executed"),
+                ("requests", "Sharded reads served through the "
+                             "dispatcher"),
+                ("launches_saved", "Collective launches removed by "
+                                   "cross-shard batching"),
+                ("max_batch", "Largest dispatcher batch so far"),
+            ):
+                self.registry.register(obs.Gauge(
+                    f"zipkin_shard_dispatch_{attr}", help_,
+                    fn=(lambda a=attr: getattr(disp, a))))
         counters = getattr(query.store, "counters", None)
         if callable(counters):
             self.registry.register(obs.CallbackFamily(
@@ -679,6 +692,17 @@ class ApiServer:
                 "query.coalesce_queries": coal.queries,
                 "query.coalesce_launches_saved": coal.launches_saved,
                 "query.coalesce_max_batch": coal.max_batch,
+            })
+        disp = getattr(self.query.store, "dispatcher", None)
+        if disp is not None:
+            # Store-level twin of the coalescer block: collective
+            # launches the cross-shard dispatcher fused away
+            # (docs/SHARDING.md).
+            out.update({
+                "shard.dispatch_batches": disp.batches,
+                "shard.dispatch_requests": disp.requests,
+                "shard.dispatch_launches_saved": disp.launches_saved,
+                "shard.dispatch_max_batch": disp.max_batch,
             })
         eng = getattr(self.query, "engine", None)
         if eng is not None:

@@ -133,6 +133,25 @@ def _host_clocks(store) -> Optional[dict]:
     }
 
 
+def _sharded_clocks(store) -> Optional[dict]:
+    """The sharded store's host pacing clocks, captured under the same
+    read lock as the stacked-state gather. The top-level
+    ``wal_applied`` key keeps save()'s WAL-truncation coordination
+    identical across store kinds (a ShardedWal truncates by epoch
+    sequence exactly as a WriteAheadLog does by record sequence)."""
+    inner = getattr(store, "inner", None)
+    if inner is None or not hasattr(inner, "_wp_upper"):
+        return None
+    return {
+        "sharded": 1,
+        "wp_upper": int(inner._wp_upper),
+        "archived_lower": int(inner._archived_lower),
+        "batches_since_sweep": int(inner._batches_since_sweep),
+        "step_seq": int(getattr(store, "_step_seq", 0)),
+        "wal_applied": int(getattr(store, "_wal_applied", 0)),
+    }
+
+
 def _dict_dump(d) -> list:
     # One entry codec shared with the WAL's dictionary deltas
     # (wal/record.py): replay equality-verifies restored entries
@@ -310,6 +329,12 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
     # contract: drain-queries → drain-pipeline → seal → gather).
     for eng in getattr(store, "query_engines", lambda: ())():
         eng.drain()
+    # Same quiesce for the sharded store's cross-shard dispatcher — a
+    # fused catalog/index launch mid-dispatch must finish before the
+    # gather's cut.
+    dispatcher = getattr(store, "dispatcher", None)
+    if dispatcher is not None:
+        dispatcher.drain()
     # A TieredSpanStore (store/archive) snapshots as its hot device
     # store plus the segment manifest; the segments themselves are
     # immutable host blobs, so they add host IO only — never device
@@ -355,7 +380,8 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
             # mirrors advance inside the commit's write-lock hold, so
             # (state, clocks, applied WAL seq) is one consistent cut —
             # the anchor deterministic replay resumes from.
-            clocks = None if n_shards else _host_clocks(store)
+            clocks = (_sharded_clocks(store) if n_shards
+                      else _host_clocks(store))
             state = store.states if n_shards else store.state
             host_state = jax.device_get(state)
         for name in dev.StoreState._FIELDS:
@@ -376,7 +402,8 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
         try:
             with store._rw.read():
                 _seal_barrier(store)  # same argument as the fast path
-                clocks = None if n_shards else _host_clocks(store)
+                clocks = (_sharded_clocks(store) if n_shards
+                          else _host_clocks(store))
                 gen = _state_generation(store, n_shards,
                                         chunk_deadline_s)
                 if os.path.isdir(staging):
@@ -879,6 +906,28 @@ def load(path: str, mesh=None, config_defaults=None):
         # Links resolve at ingest now; the mirror only paces time-bucket
         # rotation, so resume with the cadence clock at "just rotated".
         store.inner._archived_lower = store.inner._wp_upper
+        # The restored aggregates were never deltas on this process's
+        # per-shard mirror twins: resync lazily on the first
+        # sketch-tier read (FleetMirror.mark_cold cascades).
+        fm = getattr(store, "_fleet_mirror", None)
+        if fm is not None:
+            fm.mark_cold()
+        clocks = meta.get("clocks")
+        if clocks and clocks.get("sharded"):
+            # Revision-16 sharded snapshots carry the fleet pacing
+            # clocks: restore them EXACTLY so a ShardedWal tail replay
+            # re-cuts the uncrashed fleet's launches bitwise — the
+            # same contract as the single-device clocks below.
+            store.inner._wp_upper = int(clocks["wp_upper"])
+            store.inner._archived_lower = int(clocks["archived_lower"])
+            store.inner._batches_since_sweep = int(
+                clocks["batches_since_sweep"])
+            # The store is load-local (not yet published to any
+            # reader/writer thread), so the bare clock store is
+            # race-free.
+            store._step_seq = int(  # graftlint: disable=guarded-by
+                clocks.get("step_seq", 0))
+            store._wal_applied = int(clocks.get("wal_applied", 0))
         return store
     with store._rw.write():
         store.state = store.state.replace(**upd)

@@ -80,7 +80,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pipelined ingest: overlap host encode + H2D "
                         "staging with device compute behind a bounded "
                         "prefetch queue of this depth (0 = serial "
-                        "write path; single-device stores only — see "
+                        "write path; on --shards N the pipeline feeds "
+                        "every shard's fused commit — see "
                         "docs/INGEST_PIPELINE.md)")
     p.add_argument("--capture-backlog", type=int, default=4,
                    help="cold-tier async sealer: bound on pulled-but-"
@@ -91,8 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write-ahead log dir: journal every ingest "
                         "batch before commit, replay the tail at boot, "
                         "and switch scribe/kafka receivers to "
-                        "ack-after-durable-append (single-device "
-                        "stores only; see docs/DURABILITY.md)")
+                        "ack-after-durable-append; on --shards N this "
+                        "is a per-shard + group-commit-epoch log tree "
+                        "(see docs/DURABILITY.md, docs/SHARDING.md)")
     p.add_argument("--wal-fsync", default="interval",
                    choices=("batch", "interval", "off"),
                    help="WAL fsync policy: per-batch, group-commit "
@@ -223,20 +225,23 @@ def build_app(args):
                 )
             mesh = Mesh(np.array(devices[:args.shards]),
                         axis_names=("shard",))
-            # Windowed analytics stays OFF on the sharded store: it
-            # has no windowed read path (no sketch mirror, no
-            # cross-shard cell merge) and the sharded encode never
-            # computes error flags — enabling the arena would spend
-            # the fused-step census bump on unreadable cells.
-            # Per-shard windowed analytics is an open item (like the
-            # per-shard WAL).
+            # Windowed analytics runs per shard (the fused step bumps
+            # every shard's cell census); reads merge the shard
+            # mirrors' arenas lazily into the fleet view
+            # (store/mirror.FleetMirror) with zero device round-trips
+            # — docs/SHARDING.md.
             store = ShardedSpanStore(
                 mesh, StoreConfig(
                     capacity=args.capacity,
                     batch_spans=args.batch_spans,
                     use_pallas=args.use_pallas,
                     rank_path=args.rank_path,
-                )
+                    window_seconds=args.window_seconds,
+                    window_buckets=args.window_buckets,
+                ),
+                dispatch_window_s=(
+                    args.query_window_ms / 1000.0
+                    if args.query_window_ms is not None else 0.0),
             )
         else:
             from zipkin_tpu.store.device import StoreConfig
@@ -273,17 +278,34 @@ def build_app(args):
     if args.wal_dir:
         if not hasattr(hot, "attach_wal"):
             raise SystemExit(
-                "--wal-dir requires the single-device store (the "
-                "sharded store's per-shard journal is not wired yet)"
+                "--wal-dir requires a device store (the in-memory "
+                "reference store has no journaled commit path)"
             )
-        from zipkin_tpu.wal import WriteAheadLog, replay_into
+        from zipkin_tpu.wal import ShardedWal, WriteAheadLog, replay_into
 
-        wal = WriteAheadLog(
-            args.wal_dir, fsync=args.wal_fsync,
-            interval_s=args.wal_fsync_interval,
-            segment_bytes=args.wal_segment_bytes,
-            retain_bytes=args.wal_retain_bytes,
-        )
+        n_shards = getattr(hot, "n", 0)
+        if n_shards:
+            # Per-shard segment logs + a group-commit epoch log: one
+            # journal entry per fused launch unit, recovery replays
+            # only COMPLETE epochs (wal/sharded.py).
+            if args.ship_port or args.wal_retain_bytes:
+                raise SystemExit(
+                    "--ship-port/--wal-retain-bytes are single-log "
+                    "features; the sharded group-commit log does not "
+                    "ship to followers yet"
+                )
+            wal = ShardedWal(
+                args.wal_dir, n_shards, fsync=args.wal_fsync,
+                interval_s=args.wal_fsync_interval,
+                segment_bytes=args.wal_segment_bytes,
+            )
+        else:
+            wal = WriteAheadLog(
+                args.wal_dir, fsync=args.wal_fsync,
+                interval_s=args.wal_fsync_interval,
+                segment_bytes=args.wal_segment_bytes,
+                retain_bytes=args.wal_retain_bytes,
+            )
         # Boot-time recovery: the checkpoint (restored above, or a
         # fresh store) is the base; every WAL record past its applied
         # sequence replays through the normal ingest path — capture,

@@ -1,0 +1,256 @@
+"""Cross-shard query dispatcher: N concurrent sharded reads, ONE
+collective launch per micro-window.
+
+The r14 `_coll_lock` fix made concurrent sharded reads *correct* by
+serializing every shard_map collective launch (the XLA CPU rendezvous
+deadlock), but correctness-by-queueing is a throughput ceiling: N API
+threads each pay a full collective dispatch, back to back. This module
+generalizes ``query/coalesce.ResidentCoalescer`` from "batch trace-id
+queries into one get_trace_ids_multi call" to "batch ANY sharded
+collective read into one launch":
+
+- **catalog reads** (``ShardedSpanStore._cat`` — service presence,
+  histogram/top-k rows, HLL registers, spans_seen): ≥2 concurrent
+  requests fuse into ONE catalog-bundle launch
+  (``_fetch_cat_bundle``) that all-reduces every catalog array in a
+  single shard_map program; the host slices each caller's row. A lone
+  request keeps the cheap singular per-key kernel.
+- **index top-k reads** (``get_trace_ids_by_name`` /
+  ``get_trace_ids_by_annotation``): concurrent requests ride one
+  ``get_trace_ids_multi`` call — the batched multi-probe mesh kernel —
+  exactly the ResidentCoalescer move, one tier lower (the engine's
+  coalescer batches requests per engine; this batches across
+  everything hitting the store, engines included).
+
+Both merges are host-side monoid folds of per-shard results (psum/pmax
+in-graph, row slicing on the host), so batched answers are bitwise
+identical to serialized ones — gated by tests/test_parallel.py and the
+bench_smoke ``run_sharded`` phase.
+
+Executor discipline matches ResidentCoalescer: one standing daemon
+thread, started lazily; double-buffered pending list; ``window_s``
+applies only on idle entry (a batch built while a launch ran needs no
+extra wait); after ``close()`` callers degrade to inline execution.
+One addition: the store's singular fallbacks re-enter the public query
+methods (``get_trace_ids_multi``'s distrusted-bucket path), so a
+request arriving FROM the executor thread itself executes inline
+instead of enqueueing — the executor waiting on itself would deadlock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+
+class _Req:
+    """One caller's request + its rendezvous state."""
+
+    __slots__ = ("kind", "payload", "result", "error", "done")
+
+    def __init__(self, kind: str, payload):
+        self.kind = kind  # "cat" | "ids"
+        self.payload = payload
+        self.result = None
+        self.error = None
+        self.done = False
+
+
+class CrossShardDispatcher:
+    """Standing micro-batch executor for a ``ShardedSpanStore``.
+
+    The store routes ``_cat`` and the singular top-k entry points here
+    while the dispatcher is open; ``window_s`` (writable at runtime)
+    widens batches when traffic is bursty rather than continuous.
+    """
+
+    def __init__(self, store, window_s: float = 0.0, registry=None):
+        self.store = store
+        self.window_s = window_s
+        self._cv = threading.Condition()  # lock-order: 15 coalesce
+        self._pending: List[_Req] = []  # guarded-by: _cv
+        self._inflight = 0  # guarded-by: _cv
+        self._closed = False  # guarded-by: _cv
+        self.batches = 0
+        self.requests = 0
+        self.launches_saved = 0
+        self.max_batch = 0
+        from zipkin_tpu import obs
+
+        reg = registry or obs.default_registry()
+        # Requests per dispatcher batch — the amortization observable
+        # (mean > 1 ⇔ concurrent sharded reads genuinely shared
+        # collective launches).
+        self._h_size = reg.register(obs.LatencySketch(
+            "zipkin_shard_dispatch_batch_size",
+            "Concurrent sharded reads sharing one dispatcher batch",
+            min_value=1.0))
+        # Started lazily: a store constructed for a handful of reads
+        # never pays a standing thread it didn't use.
+        self._thread: Optional[threading.Thread] = None
+
+    # -- public request surface ------------------------------------------
+
+    def cat(self, key: str, row=None):
+        """One catalog entry (optionally one row of it), batched with
+        every concurrent catalog read into one fused launch."""
+        return self._submit(_Req("cat", (key, row)))
+
+    def ids(self, query: tuple):
+        """One get_trace_ids_multi-style query tuple, batched with
+        every concurrent index read into one multi-probe launch."""
+        return self._submit(_Req("ids", query))
+
+    def _submit(self, req: _Req):
+        with self._cv:
+            closed = self._closed
+            reentrant = threading.current_thread() is self._thread
+            if not closed and not reentrant:
+                self._ensure_thread()
+                self._pending.append(req)
+                self._cv.notify_all()
+                while not req.done:
+                    self._cv.wait()
+                if req.error is not None:
+                    raise req.error
+                return req.result
+        # Closed (ordered shutdown) or called FROM the executor thread
+        # (a singular fallback re-entering the public query surface):
+        # execute inline — enqueueing from the executor would deadlock
+        # on its own batch.
+        self._execute([req])
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- executor thread -------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        # Caller holds _cv and has checked not-closed.
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="zipkin-shard-dispatch",
+                daemon=True)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                waited = False
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                    waited = True
+                if self._closed and not self._pending:
+                    return
+            # Idle-entry window only (see ResidentCoalescer): a batch
+            # built while the previous launch ran dispatches now.
+            w = self.window_s
+            if waited and w and w > 0:
+                time.sleep(w)
+            with self._cv:
+                batch, self._pending = self._pending, []
+                self._inflight = len(batch)
+            try:
+                self._execute(batch)
+            finally:
+                with self._cv:
+                    self._inflight = 0
+                    self._cv.notify_all()
+
+    def _execute(self, batch: List[_Req]) -> None:
+        """Resolve one batch: every cat request through ≤1 fused
+        catalog launch, every ids request through ≤1 multi-probe
+        launch. Per-group error fan-out (a failing catalog launch must
+        not poison the index reads riding the same batch)."""
+        store = self.store
+        cat_reqs = [r for r in batch if r.kind == "cat"]
+        ids_reqs = [r for r in batch if r.kind == "ids"]
+        saved = 0
+        if cat_reqs:
+            try:
+                fused = (len(cat_reqs) >= 2 and all(
+                    r.payload[0] in store.CAT_BUNDLE_KEYS
+                    for r in cat_reqs))
+                if fused:
+                    bundle = store._fetch_cat_bundle()
+                    saved += len(cat_reqs) - 1
+                for r in cat_reqs:
+                    key, row = r.payload
+                    entry = (bundle[key] if fused
+                             else store._cat_direct(key))
+                    r.result = entry if row is None else entry[row]
+            except BaseException as e:  # noqa: BLE001 — per-request
+                for r in cat_reqs:
+                    if r.error is None and r.result is None:
+                        r.error = e
+        if ids_reqs:
+            try:
+                if len(ids_reqs) == 1:
+                    q = ids_reqs[0].payload
+                    if q[0] == "name":
+                        ids_reqs[0].result = (
+                            store._get_trace_ids_by_name_direct(*q[1:]))
+                    else:
+                        ids_reqs[0].result = (
+                            store._get_trace_ids_by_annotation_direct(
+                                *q[1:]))
+                else:
+                    res = store.get_trace_ids_multi(
+                        [r.payload for r in ids_reqs])
+                    for r, ids in zip(ids_reqs, res):
+                        r.result = ids
+                    saved += len(ids_reqs) - 1
+            except BaseException as e:  # noqa: BLE001 — per-request
+                for r in ids_reqs:
+                    if r.error is None and r.result is None:
+                        r.error = e
+        with self._cv:
+            for r in batch:
+                if r.result is None and r.error is None:
+                    # A valid empty answer is [] / an array, never None
+                    # — None here means the group body died before
+                    # assigning.
+                    if r.kind == "ids":
+                        r.result = []
+                r.done = True
+            self.batches += 1
+            self.requests += len(batch)
+            self.launches_saved += saved
+            self.max_batch = max(self.max_batch, len(batch))
+            self._cv.notify_all()
+        self._h_size.observe(max(len(batch), 1))
+
+    # -- lifecycle -------------------------------------------------------
+
+    def drain(self) -> None:
+        """Block until the executor is idle (nothing pending, nothing
+        in flight) — the quiesce barrier checkpoint/close use."""
+        with self._cv:
+            while self._pending or self._inflight:
+                self._cv.wait(timeout=0.5)
+
+    def close(self) -> None:
+        """Stop the executor thread (processing everything already
+        queued); later requests execute inline."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    @property
+    def closed(self) -> bool:
+        with self._cv:
+            return self._closed
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "batches": self.batches,
+                "requests": self.requests,
+                "launches_saved": self.launches_saved,
+                "max_batch": self.max_batch,
+            }

@@ -10,6 +10,7 @@ the sampler/query layer needs crosses shards via ICI collectives only.
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
 from typing import Dict, Optional, Tuple
 
@@ -267,10 +268,11 @@ def stack_batches(batches) -> Tuple:
 # ---------------------------------------------------------------------------
 
 
+from zipkin_tpu.store.analytics import WindowedAnalytics
 from zipkin_tpu.store.base import SuspectGuard
 
 
-class ShardedSpanStore(SuspectGuard):
+class ShardedSpanStore(WindowedAnalytics, SuspectGuard):
     """SpanStore SPI over an n-shard device mesh.
 
     Writes route whole traces to shards by trace-id hash (the role of
@@ -288,12 +290,16 @@ class ShardedSpanStore(SuspectGuard):
     """
 
     def __init__(self, mesh: Mesh, config: dev.StoreConfig,
-                 axis: str = "shard", codec=None):
+                 axis: str = "shard", codec=None, registry=None,
+                 dispatch_window_s: float = 0.0):
         import threading
 
+        from zipkin_tpu import obs
         from zipkin_tpu.columnar.encode import SpanCodec
         from zipkin_tpu.concurrency import RWLock
+        from zipkin_tpu.parallel.dispatch import CrossShardDispatcher
         from zipkin_tpu.store.base import PinBank
+        from zipkin_tpu.store.mirror import FleetMirror, SketchMirror
 
         self.mesh = mesh
         self.axis = axis
@@ -329,6 +335,45 @@ class ShardedSpanStore(SuspectGuard):
         # Dedicated LEAF below the read-lock hold (40 -> 45); never
         # held across anything that blocks on another launch.
         self._coll_lock = threading.Lock()  # lock-order: 45 collective-launch
+        # Monotonic collective-launch count (one per _coll_lock hold):
+        # the dispatcher-batching counter-proof reads deltas of this.
+        self._coll_launches = 0  # guarded-by: _coll_lock
+        # Host commit frontier: _step_seq advances inside every
+        # donating write-lock hold; _read_epoch covers host-only
+        # visibility changes (pin/TTL mutations) — together the query
+        # engine's result-cache key (write_frontier()).
+        self._step_seq = 0
+        self._read_epoch = 0
+        # Per-shard sketch-mirror twins (store/mirror.py), fed deltas
+        # on the commit path, merged lazily into the fleet view the
+        # engine sketch tier and the windowed-analytics mixin read.
+        self._mirrors = [SketchMirror(config, dicts=self.codec.dicts)
+                         for _ in range(self.n)]
+        self._fleet_mirror = FleetMirror(config, self._mirrors,
+                                         lambda: self._step_seq)
+        # Durable write-ahead log (wal/sharded.ShardedWal) + pipelined
+        # ingest (store/pipeline) — both optional, attached/started by
+        # the deployment wiring (main/example.py --wal-dir/--pipeline).
+        self.wal = None
+        self._wal_marks = None  # guarded-by: _lock
+        self._wal_applied = 0
+        self._pipeline = None  # guarded-by: _lock
+        self._registry = reg = registry or obs.default_registry()
+        # Per-shard occupancy/lap gauges: hash-partition imbalance is
+        # invisible in the summed counters() totals.
+        self._occ_family = reg.register(obs.CallbackFamily(
+            "zipkin_shard_occupancy",
+            "Per-shard span ring occupancy (hash-partition skew view)",
+            "shard", self._occupancy_by_shard))
+        self._laps_family = reg.register(obs.CallbackFamily(
+            "zipkin_shard_ring_laps",
+            "Per-shard span ring laps (eviction-pressure skew view)",
+            "shard", self._laps_by_shard))
+        # Cross-shard query dispatcher: concurrent API reads coalesce
+        # into one collective launch per micro-window instead of
+        # queueing singly behind _coll_lock.
+        self._dispatcher = CrossShardDispatcher(
+            self, window_s=dispatch_window_s, registry=reg)
 
     @property
     def dicts(self):
@@ -338,8 +383,34 @@ class ShardedSpanStore(SuspectGuard):
     def states(self):
         return self.inner.states
 
+    @property
+    def dispatcher(self):
+        return self._dispatcher
+
+    def collective_launches(self) -> int:
+        """Monotonic count of collective query launches (each one a
+        _coll_lock hold). The dispatcher-batching acceptance test
+        proves N concurrent reads land in ≤2 launches by differencing
+        this around the burst."""
+        with self._coll_lock:
+            return self._coll_launches
+
     def close(self) -> None:
-        pass
+        """Ordered shutdown: stop the dispatcher (queued reads finish;
+        later ones execute inline), drain+stop the pipeline, force the
+        WAL durable, and unregister the per-shard gauge families. The
+        WAL object itself stays open (its owner closes it, after any
+        final checkpoint truncation)."""
+        d = self.__dict__.get("_dispatcher")
+        if d is not None:
+            d.close()
+        self.stop_pipeline(raise_errors=False)
+        if self.wal is not None:
+            self.wal.sync()
+        for fam in (self.__dict__.get("_occ_family"),
+                    self.__dict__.get("_laps_family")):
+            if fam is not None and self._registry.get(fam.name) is fam:
+                self._registry.unregister(fam.name)
 
     # -- resident query engines (query/engine.py; the duck-typed twin
     # of ReadSpanStore's registry, so Collector.flush/close and
@@ -376,10 +447,14 @@ class ShardedSpanStore(SuspectGuard):
             for s in spans:
                 self.ttls.setdefault(to_signed64(s.trace_id), 1.0)
             prune_ttls(self.ttls, TpuSpanStore.MAX_TTL_ENTRIES)
+            if self.pins:
+                # Pin-bank arrivals change read answers before the
+                # commit bumps the frontier — invalidate cached reads.
+                self._bump_read_epoch()
             self.pins.note_write(to_signed64, spans)
             self._apply_locked(list(spans))
 
-    def _apply_locked(self, spans) -> None:
+    def _apply_locked(self, spans) -> None:  # called-under: _lock
         from zipkin_tpu.store.base import should_index
         from zipkin_tpu.store.tpu import _next_pow2, name_lc_ids
 
@@ -421,30 +496,225 @@ class ShardedSpanStore(SuspectGuard):
             )]
             groups = [[] for _ in range(self.n)]
             groups[self._shard_of(s.trace_id)] = spans
-        dbs = []
         batches = [self.codec.encode(g) for g in groups]
-        pad_s = _next_pow2(max(b.n_spans for b in batches))
-        pad_a = _next_pow2(max(b.n_annotations for b in batches))
-        pad_b = _next_pow2(max(b.n_binary for b in batches))
+        parts = []
         for g, batch in zip(groups, batches):
             indexable = np.fromiter(
                 (should_index(s) for s in g), bool, len(g)
             )
             lc = name_lc_ids(batch, self.dicts, self._name_lc)
-            dbs.append(dev.make_device_batch(
-                batch, lc, indexable,
+            parts.append((batch, lc, indexable))
+        unit = self._build_unit(parts)
+        if self.wal is not None:
+            # Journal BEFORE the donating commit (ack-after-append,
+            # docs/DURABILITY.md) and under self._lock, so append
+            # order == encode order == commit order — the property
+            # the dictionary-delta replay chain depends on.
+            unit = unit._replace(wal_seq=self._journal_unit(parts))
+        if self._pipeline is not None:
+            # Pipelined sharded ingest: stage 2 device_puts via
+            # stage_unit, stage 3 runs _commit_unit — all shards'
+            # commits ride one fused mesh launch per unit.
+            self._pipeline.feed(unit)
+            return
+        unit = unit._replace(db=self.stage_unit(unit.db))
+        self._commit_unit(unit)
+
+    def _build_unit(self, parts):
+        """Host stage-1 body shared by the serial writer, the ingest
+        pipeline, and WAL replay: pad every shard's encoded part to
+        fleet-wide pow2 buckets, stack host-side, and compute each
+        shard's sketch-mirror delta from the PRE-PAD columns. ``parts``
+        is one (SpanBatch, name_lc, indexable) triple per shard, in
+        shard order. Journaled parts replayed through this same body
+        re-cut bitwise-identical launches (wal/recovery)."""
+        from zipkin_tpu.aggregate import windows as win_mod
+        from zipkin_tpu.store.pipeline import IngestUnit
+        from zipkin_tpu.store.tpu import _next_pow2
+
+        batches = [b for b, _, _ in parts]
+        pad_s = _next_pow2(max(b.n_spans for b in batches))
+        pad_a = _next_pow2(max(b.n_annotations for b in batches))
+        pad_b = _next_pow2(max(b.n_binary for b in batches))
+        if self.config.window_enabled:
+            ea, eb = win_mod.error_ids(self.dicts)
+            err_of = lambda b: win_mod.span_error_flags(b, ea, eb)  # noqa: E731
+        else:
+            err_of = lambda b: None  # noqa: E731 — flag lowers out
+        dbs = [
+            dev.make_device_batch(
+                b, lc, ix,
                 pad_spans=pad_s, pad_anns=pad_a, pad_banns=pad_b,
-            ))
-        stacked = jax.device_put(
-            stack_batches(dbs), NamedSharding(self.mesh, P(self.axis))
+                error_flag=err_of(b),
+            )
+            for b, lc, ix in parts
+        ]
+        sketch = tuple(
+            m.delta_of([part])
+            for m, part in zip(self._mirrors, parts)
         )
-        # incoming from the HOST batches: reading it off the stacked
-        # device pytree inside the write-lock hold was a device sync
-        # stalling every reader behind the commit (graftlint
-        # sync-under-lock, the r10 group-commit stall class).
-        incoming = max(b.n_spans for b in batches)
+        return IngestUnit(
+            stack_batches(dbs),
+            sum(b.n_spans for b in batches),
+            sum(b.n_annotations for b in batches),
+            sum(b.n_binary for b in batches),
+            self.n, False, sketch=sketch,
+            # incoming from the HOST batches: reading it off the
+            # stacked device pytree inside the write-lock hold was a
+            # device sync stalling every reader behind the commit
+            # (graftlint sync-under-lock, the r10 group-commit stall
+            # class).
+            incoming=max(b.n_spans for b in batches),
+        )
+
+    def stage_unit(self, db):
+        """Stage-2 H2D: place the host-stacked batch pytree over the
+        mesh. The pipeline's stage thread calls this hook (see
+        IngestPipeline); the serial path runs it inline."""
+        return jax.device_put(db, NamedSharding(self.mesh, P(self.axis)))
+
+    def _commit_unit(self, unit) -> None:
+        """Stage 3 — the ONE donating commit body behind the serial
+        writer, the pipeline's commit thread, and WAL replay (the
+        TpuSpanStore._commit_unit contract over the mesh). The sharded
+        ingest launch (and its in-graph psum/pmax summary) runs under
+        the WRITE lock, which excludes every reader — so ingest
+        collectives can never overlap a query collective and need no
+        _coll_lock. Mirror deltas fold inside the same hold, BEFORE
+        the frontier bump, so a sketch-tier read at frontier F already
+        includes commit F."""
+        self.ensure_writable()
         with self._rw.write():
-            self.inner.ingest(stacked, incoming=incoming)
+            self.inner.ingest(unit.db, incoming=unit.incoming)
+            if unit.sketch is not None:
+                for m, d in zip(self._mirrors, unit.sketch):
+                    m.apply(d)
+            self._step_seq += 1
+            if unit.wal_seq is not None:
+                self._wal_applied = unit.wal_seq
+
+    # -- durable write-ahead log (zipkin_tpu.wal.sharded) ----------------
+
+    def attach_wal(self, wal) -> None:
+        """Journal every subsequent launch unit into ``wal`` (a
+        ShardedWal: one segment log per shard + the group-commit epoch
+        log) before its donating commit. Attach before live writes —
+        units committed earlier are only covered by checkpoints. The
+        store does not own the log's lifecycle."""
+        from zipkin_tpu.wal.record import dict_sizes
+
+        with self._lock:
+            self.wal = wal
+            self._wal_marks = dict_sizes(self.dicts)
+
+    def _journal_unit(self, parts) -> int:  # called-under: _lock
+        """Append one sharded launch unit — every shard's part plus
+        the dictionary entries its encode step added — as one
+        group-commit epoch; returns the epoch sequence. Runs on the
+        encoding thread under self._lock."""
+        from zipkin_tpu.wal.record import dict_sizes, dump_dict_deltas
+
+        sizes, deltas = dump_dict_deltas(self.dicts, self._wal_marks)
+        seq = self.wal.append_unit(parts, self._wal_marks, deltas)
+        self._wal_marks = sizes
+        return seq
+
+    def wal_sync(self) -> None:
+        """Force the attached WAL durable; no-op without one."""
+        if self.wal is not None:
+            self.wal.sync()
+
+    # -- pipelined ingest lifecycle (store/pipeline) ---------------------
+
+    PIPELINE_DEPTH = 8
+    STAGE_BUFFERS = 2
+
+    def start_pipeline(self, depth: Optional[int] = None,
+                       stage_buffers: Optional[int] = None):
+        """Switch the write path to the three-stage ingest pipeline:
+        apply() becomes stage 1 (encode + partition + pad + host
+        stack, outside the device critical section), a stage thread
+        places units over the mesh (stage_unit), and a commit thread
+        holds the write lock only for the fused all-shard donating
+        swap — the PR 4 pipeline driving every shard's commit body
+        concurrently. Same quiesce rules as TpuSpanStore."""
+        from zipkin_tpu.store.pipeline import IngestPipeline
+
+        with self._lock:
+            if self._pipeline is not None:
+                raise RuntimeError("ingest pipeline already running")
+            self._pipeline = IngestPipeline(
+                self, depth or self.PIPELINE_DEPTH,
+                registry=self._registry,
+                stage_buffers=stage_buffers or self.STAGE_BUFFERS)
+            return self._pipeline
+
+    def drain_pipeline(self) -> None:
+        """Block until every accepted batch is committed on every
+        shard (no-op when no pipeline runs); re-raises a parked
+        pipeline error."""
+        with self._lock:
+            p = self._pipeline
+        if p is not None:
+            p.drain()
+
+    def stop_pipeline(self, raise_errors: bool = True) -> None:
+        """Drain, stop the pipeline threads, and return to the serial
+        write path — quiesced UNDER the encode lock with the pipeline
+        still published (two concurrent device writers would break the
+        ring-scatter contract; see TpuSpanStore.stop_pipeline)."""
+        with self._lock:
+            p = self._pipeline
+            if p is None:
+                return
+            p.stop()
+            self._pipeline = None
+        err = p.take_error()
+        if raise_errors and err is not None:
+            raise err
+
+    @contextlib.contextmanager
+    def pipelined(self, depth: Optional[int] = None):
+        """Scoped pipelined ingest: drains and stops on exit."""
+        pipe = self.start_pipeline(depth)
+        try:
+            yield pipe
+        finally:
+            self.stop_pipeline()
+
+    # -- query-engine hooks (query/engine.py) ----------------------------
+
+    def write_frontier(self) -> Tuple[int, int]:
+        """Monotonic host-mirrored commit frontier — the result-cache
+        key component (same contract as TpuSpanStore.write_frontier).
+        No device traffic."""
+        return (self._step_seq, self._read_epoch)
+
+    def _bump_read_epoch(self) -> None:
+        self._read_epoch += 1
+
+    def ensure_sketch_mirror(self):
+        """The fleet sketch mirror (FleetMirror over the per-shard
+        twins), resynced from the device aggregates if a state swap
+        left any shard cold (checkpoint restore) — one batched D2H of
+        the stacked arrays (a plain sharded device_get, NOT a
+        collective program, so no _coll_lock), after which incremental
+        per-commit deltas keep every shard warm with zero device
+        traffic."""
+        fm = self._fleet_mirror
+        if not fm.warm:
+            with self._rw.read():
+                st = self.states
+                host = jax.device_get((
+                    st.svc_hist, st.ann_svc_counts, st.name_presence,
+                    st.ann_value_counts, st.bann_key_counts,
+                    st.hll_traces, st.win_epoch, st.win_counts,
+                    st.win_sums, st.win_mm,
+                ))
+                for i, m in enumerate(self._mirrors):
+                    if not m.warm:
+                        m.adopt(*(np.asarray(h)[i] for h in host))
+        return fm
 
     DEFAULT_TTL_S = 1.0
 
@@ -458,9 +728,14 @@ class ShardedSpanStore(SuspectGuard):
             pin = ttl_seconds > self.DEFAULT_TTL_S
             if not pin:
                 self.pins.unpin(tid)
+            # Pin/unpin changes read answers without a commit — the
+            # result cache must not serve the stale frontier.
+            self._bump_read_epoch()
         if pin:
             fill_pin(self.pins, self._lock, tid, lambda: (
                 self.get_spans_by_trace_ids([trace_id]) or [[]])[0])
+            with self._lock:
+                self._bump_read_epoch()
 
     def get_time_to_live(self, trace_id: int) -> float:
         from zipkin_tpu.columnar.encode import to_signed64
@@ -492,6 +767,7 @@ class ShardedSpanStore(SuspectGuard):
         the device_get complete inside the hold, so no second
         collective can be in flight."""
         with self._coll_lock:
+            self._coll_launches += 1
             return jax.device_get(kernel(*args))
 
     def _unstack(self, state):
@@ -782,7 +1058,17 @@ class ShardedSpanStore(SuspectGuard):
             truncated |= n_valid >= kk
         return cands, truncated
 
-    def get_trace_ids_by_name(self, service_name, span_name, end_ts, limit):
+    def get_trace_ids_by_name(self, service_name, span_name, end_ts,
+                              limit):
+        """Top-k trace ids by (service[, span name]) via the
+        cross-shard dispatcher: concurrent index reads ride ONE
+        multi-probe mesh launch (get_trace_ids_multi) instead of
+        queueing singly behind _coll_lock."""
+        return self._dispatcher.ids(
+            ("name", service_name, span_name, end_ts, limit))
+
+    def _get_trace_ids_by_name_direct(self, service_name, span_name,
+                                      end_ts, limit):
         from zipkin_tpu.store.base import topk_ids_with_escalation
 
         svc = self._svc_id(service_name)
@@ -828,8 +1114,17 @@ class ShardedSpanStore(SuspectGuard):
             limit, self.config.ann_capacity, fetch
         )
 
-    def get_trace_ids_by_annotation(self, service_name, annotation, value,
-                                    end_ts, limit):
+    def get_trace_ids_by_annotation(self, service_name, annotation,
+                                    value, end_ts, limit):
+        """Top-k trace ids by annotation via the cross-shard
+        dispatcher (see get_trace_ids_by_name)."""
+        return self._dispatcher.ids(
+            ("annotation", service_name, annotation, value, end_ts,
+             limit))
+
+    def _get_trace_ids_by_annotation_direct(self, service_name,
+                                            annotation, value, end_ts,
+                                            limit):
         from zipkin_tpu.models.constants import CORE_ANNOTATIONS
         from zipkin_tpu.store.base import resolve_annotation_query
 
@@ -1071,15 +1366,64 @@ class ShardedSpanStore(SuspectGuard):
 
     # -- name catalogs / analytics --------------------------------------
 
-    def _cat(self, key, row=None):
-        """Read-locked fetch of one collective catalog entry (optionally
-        one row of it) — a single D2H transfer."""
+    # Catalog keys the fused bundle kernel serves — everything the
+    # dispatcher may merge into ONE launch. Keys outside this set
+    # (none today) would fall back to their singular kernels.
+    CAT_BUNDLE_KEYS = frozenset((
+        "svc_hist", "ann_svc_counts", "name_presence",
+        "ann_value_counts", "bann_key_counts", "spans_seen",
+        "hll_traces",
+    ))
+
+    def _cat_bundle_kernel(self):
+        """ONE collective program all-reducing every catalog array the
+        dispatcher can serve: ≥2 concurrent catalog reads sharing a
+        micro-window cost one launch total instead of one launch each
+        behind _coll_lock."""
+
+        def build():
+            def fn(state):
+                st = self._unstack(state)
+                out = {k: jax.lax.psum(getattr(st, k), self.axis)
+                       for k in ("svc_hist", "ann_svc_counts",
+                                 "name_presence", "ann_value_counts",
+                                 "bann_key_counts")}
+                out["spans_seen"] = jax.lax.psum(
+                    st.counters["spans_seen"], self.axis)
+                out["hll_traces"] = jax.lax.pmax(st.hll_traces,
+                                                 self.axis)
+                return out
+
+            return jax.jit(compat_shard_map(
+                fn, mesh=self.mesh, in_specs=(P(self.axis),),
+                out_specs=P(), check_vma=False,
+            ))
+
+        return self._kernel(("cat_bundle",), build)
+
+    def _fetch_cat_bundle(self):
+        """Every dispatcher-servable catalog entry: one launch, one
+        D2H (the dispatcher's fused path)."""
         with self._rw.read():
             with self._coll_lock:
-                entry = self._cat_kernel(key)(self.states)
-                if row is not None:
-                    entry = entry[row]
-                return jax.device_get(entry)
+                self._coll_launches += 1
+                return jax.device_get(
+                    self._cat_bundle_kernel()(self.states))
+
+    def _cat_direct(self, key):
+        """Read-locked fetch of ONE collective catalog entry — the
+        cheap singular kernel, for a read with nothing to share a
+        launch with."""
+        with self._rw.read():
+            with self._coll_lock:
+                self._coll_launches += 1
+                return jax.device_get(self._cat_kernel(key)(self.states))
+
+    def _cat(self, key, row=None):
+        """One catalog entry (optionally one row of it), via the
+        cross-shard dispatcher: concurrent catalog reads coalesce into
+        one fused bundle launch (parallel/dispatch)."""
+        return self._dispatcher.cat(key, row)
 
     def get_all_service_names(self):
         present = self._cat("ann_svc_counts") > 0
@@ -1217,6 +1561,7 @@ class ShardedSpanStore(SuspectGuard):
         with self._rw.read():
             if start_ts is None and end_ts is None:
                 with self._coll_lock:
+                    self._coll_launches += 1
                     summary = self._summary_kernel()(self.states)
                     bank, ts_min, ts_max = jax.device_get(
                         (summary["dep_moments"], summary["ts_min"],
@@ -1290,23 +1635,18 @@ class ShardedSpanStore(SuspectGuard):
         AdaptiveSampler.scala:204-237)."""
         return float(self._cat("spans_seen"))
 
-    def counters(self) -> Dict[str, float]:
-        """Store-stage counters for /metrics: per-shard device counter
-        blocks summed across the mesh (occupancy/laps are per-shard
-        quantities, so sums read as mesh totals; ts_min/ts_max reduce
-        by min/max). Memoized on the host-side write clocks — same
-        fetched-once-per-ingest-step contract as
-        TpuSpanStore.counter_block, so scrapes between writes cost no
-        device traffic."""
-        import jax
-
-        from zipkin_tpu.store import device as dev
-
+    def _counter_blocks(self):
+        """(totals dict, per-shard [n, F] block matrix), memoized on
+        the host-side write clocks — same fetched-once-per-ingest-step
+        contract as TpuSpanStore.counter_block, so scrapes between
+        writes cost no device traffic. The per-shard matrix is a plain
+        vmap over the stacked states (no collective program, so no
+        _coll_lock)."""
         key = (self.inner._wp_upper, self.inner._batches_since_sweep,
                self.inner._archived_lower)
         memo = getattr(self, "_cblock_memo", None)
         if memo is not None and memo[0] == key:
-            return dict(memo[1])
+            return dict(memo[1]), memo[2]
         with self._rw.read():
             blocks = np.asarray(jax.device_get(jax.vmap(
                 dev.counter_block.__wrapped__
@@ -1321,5 +1661,39 @@ class ShardedSpanStore(SuspectGuard):
             else:
                 out[name] = float(col.sum())
         out["shards"] = float(self.n)
-        self._cblock_memo = (key, dict(out))
-        return out
+        self._cblock_memo = (key, dict(out), blocks)
+        return dict(out), blocks
+
+    def counters(self) -> Dict[str, float]:
+        """Store-stage counters for /metrics: per-shard device counter
+        blocks summed across the mesh (occupancy/laps are per-shard
+        quantities, so sums read as mesh totals; ts_min/ts_max reduce
+        by min/max). Per-shard SKEW — which the sums erase — is
+        surfaced separately by shard_counters() and the
+        zipkin_shard_occupancy{shard=}/zipkin_shard_ring_laps{shard=}
+        gauge families."""
+        totals, _ = self._counter_blocks()
+        return totals
+
+    def shard_counters(self):
+        """One counter dict PER SHARD, in shard order — the
+        hash-partition imbalance view counters()'s mesh totals sum
+        away."""
+        _, blocks = self._counter_blocks()
+        return [
+            {name: float(blocks[sh, i])
+             for i, name in enumerate(dev.COUNTER_BLOCK_FIELDS)}
+            for sh in range(blocks.shape[0])
+        ]
+
+    def _shard_column(self, field: str) -> Dict[str, float]:
+        i = dev.COUNTER_BLOCK_FIELDS.index(field)
+        _, blocks = self._counter_blocks()
+        return {str(sh): float(blocks[sh, i])
+                for sh in range(blocks.shape[0])}
+
+    def _occupancy_by_shard(self) -> Dict[str, float]:
+        return self._shard_column("ring_occupancy")
+
+    def _laps_by_shard(self) -> Dict[str, float]:
+        return self._shard_column("ring_laps")

@@ -67,6 +67,12 @@ class ResidentCoalescer:
     After ``close()`` the thread is gone and ``run`` degrades to
     inline per-caller execution — queries still answer during and
     after an ordered shutdown.
+
+    parallel/dispatch.CrossShardDispatcher is this executor's
+    store-level twin for the sharded deployment: same standing-thread
+    + double-buffer shape, applied one layer down so ALL cross-shard
+    collectives (catalog psums included, not just index probes) fuse
+    per micro-window.
     """
 
     def __init__(self, store, window_s: float = 0.0, registry=None,

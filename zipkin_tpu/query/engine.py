@@ -34,7 +34,9 @@ never touch the device at all, and the ones that must share launches:
    computation (so a result that raced a commit can be returned once
    but never pinned stale).
 
-Stores without a frontier (memory/sql/sharded) bypass the cache;
+Stores without a frontier (memory/sql) bypass the cache — the
+sharded store exports one (fleet step counter + read epoch) and caches
+like the single-device store;
 stores without a sketch mirror bypass tier 1 — the engine degrades to
 a thin executor facade with identical semantics.
 

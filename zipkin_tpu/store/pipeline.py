@@ -90,6 +90,10 @@ class IngestUnit(NamedTuple):
     chained: bool
     wal_seq: Optional[int] = None
     sketch: Optional[object] = None
+    # Sharded units only (parallel/shard.ShardedSpanStore): max spans
+    # any shard's part carries, precomputed HOST-side in stage 1 —
+    # ShardedStore.ingest requires it so the commit hold never syncs.
+    incoming: Optional[int] = None
 
 
 class _StageBase:
@@ -186,6 +190,10 @@ class IngestPipeline(_StageBase):
         self.stage_buffers = max(1, int(stage_buffers))
         self._staged: "queue.Queue" = queue.Queue(
             maxsize=self.stage_buffers)
+        # Stage-2 H2D hook: a sharded store places units over its mesh
+        # (ShardedSpanStore.stage_unit); the single-device store keeps
+        # the plain transfer.
+        self._stage = getattr(store, "stage_unit", None) or dev.stage_batch
         reg = registry or obs.default_registry()
         self._registry = reg
         self.h_encode = reg.register(obs.LatencySketch(
@@ -248,7 +256,7 @@ class IngestPipeline(_StageBase):
                 return
             try:
                 t0 = time.perf_counter()
-                item = item._replace(db=dev.stage_batch(item.db))
+                item = item._replace(db=self._stage(item.db))
                 self.h_stage.observe(time.perf_counter() - t0)
             except BaseException as e:  # noqa: BLE001 — parked, re-raised
                 self._park_error(e)

@@ -29,14 +29,18 @@ from zipkin_tpu.wal.recovery import (
     apply_record_into,
     recover,
     replay_into,
+    replay_sharded_into,
 )
+from zipkin_tpu.wal.sharded import ShardedWal
 
 __all__ = [
     "FsyncPolicy",
     "WalDurabilityError",
     "WriteAheadLog",
     "WalReplayError",
+    "ShardedWal",
     "apply_record_into",
     "recover",
     "replay_into",
+    "replay_sharded_into",
 ]

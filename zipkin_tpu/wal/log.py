@@ -704,6 +704,56 @@ class WriteAheadLog:
             self.c_truncated.inc(removed)
         return removed
 
+    def cut_tail(self, upto_seq: int) -> int:
+        """Physically cut the log back so ``upto_seq`` is its last
+        record — the sharded group-commit alignment (wal/sharded): a
+        crash between one member log's append and another's leaves the
+        fleet's logs at different frontiers, and every log must rewind
+        to the shortest so the epoch chain stays lockstep. Segments
+        wholly past the cut are deleted; the segment containing the
+        cut is truncated at the record boundary. Returns the number of
+        records cut (counted corrupt — they were never part of a
+        complete group and are data loss in the same operator sense as
+        a torn tail)."""
+        removed = 0
+        cut = 0
+        with self._cond:
+            if upto_seq >= self._next_seq - 1:
+                return 0
+            if self._file is not None:
+                self._file.flush()
+                self._file.close()
+                self._file = None
+            keep: List[_Segment] = []
+            for seg in self._segments:
+                if seg.base_seq > upto_seq:
+                    cut += seg.n_records
+                    self._delete_segment(seg.path)
+                    removed += 1
+                    continue
+                if seg.n_records and seg.last_seq > upto_seq:
+                    n_keep = upto_seq - seg.base_seq + 1
+                    end = None
+                    for i, _payload, off in _iter_records(seg.path):
+                        if i + 1 == n_keep:
+                            end = off
+                            break
+                    cut += seg.n_records - n_keep
+                    with open(seg.path, "r+b") as f:
+                        f.truncate(end)
+                    seg.n_records = n_keep
+                    seg.nbytes = end
+                keep.append(seg)
+            self._segments = keep
+            self._next_seq = upto_seq + 1
+            self._durable = min(self._durable, upto_seq)
+            if removed or cut:
+                _fsync_dir(self.directory)
+        if cut:
+            self.torn_records_cut += cut
+            self.c_corrupt.inc(cut)
+        return cut
+
     # -- lifecycle ------------------------------------------------------
 
     def close(self) -> None:

@@ -70,12 +70,65 @@ def apply_record_into(hot, seq: int, payload: bytes,
     return unit.n_spans
 
 
+def replay_sharded_into(store, wal,
+                        from_seq: Optional[int] = None) -> dict:
+    """Sharded twin of ``replay_into``: drive every COMPLETE epoch of
+    a ShardedWal past ``from_seq`` through the sharded store's normal
+    stage-1/stage-3 bodies (``_build_unit`` → ``stage_unit`` →
+    ``_commit_unit``), so an n-shard recovery re-cuts bitwise-identical
+    fused launches — every shard's rings, sketch-mirror twin, and the
+    fleet frontier land exactly where an uncrashed fleet's would."""
+    from zipkin_tpu.store.tpu import TpuSpanStore
+
+    if from_seq is None:
+        from_seq = int(getattr(store, "_wal_applied", 0))
+    t0 = time.perf_counter()
+    n_records = 0
+    n_spans = 0
+    pin_tids = pin_tids_of(store)
+    for seq, parts, before, deltas in wal.replay_units(from_seq):
+        apply_dict_deltas(store.dicts, before, deltas)
+        with store._lock:
+            unit = store._build_unit(parts)._replace(wal_seq=seq)
+            for batch, _lc, _ix in parts:
+                for tid in np.unique(batch.trace_id):
+                    store.ttls.setdefault(int(tid), 1.0)
+                if pin_tids is not None and len(pin_tids):
+                    keep = np.isin(batch.trace_id, pin_tids)
+                    if keep.any():
+                        pinned = TpuSpanStore._select_batch(batch, keep)
+                        store._bump_read_epoch()
+                        store.pins.note_write(
+                            to_signed64, store.codec.decode(pinned))
+            from zipkin_tpu.store.base import prune_ttls
+
+            prune_ttls(store.ttls, TpuSpanStore.MAX_TTL_ENTRIES)
+            unit = unit._replace(db=store.stage_unit(unit.db))
+            store._commit_unit(unit)
+        n_spans += unit.n_spans
+        wal.c_replayed.inc()
+        n_records += 1
+    with store._lock:
+        store._wal_marks = dict_sizes(store.dicts)
+    return {
+        "replayed_records": n_records,
+        "replayed_spans": n_spans,
+        "replay_s": round(time.perf_counter() - t0, 3),
+        "applied_seq": int(store._wal_applied),
+        "torn_records_cut": int(wal.torn_records_cut),
+    }
+
+
 def replay_into(store, wal, from_seq: Optional[int] = None) -> dict:
     """Replay every WAL record with seq > ``from_seq`` (default: the
     store's restored applied frontier) through the normal ingest path.
     Accepts a TpuSpanStore or a TieredSpanStore (replay routes through
     the hot store; an attached eviction sink captures and seals
-    exactly as live ingest would). Returns replay stats."""
+    exactly as live ingest would), or a ShardedSpanStore paired with a
+    ShardedWal (dispatched to ``replay_sharded_into``). Returns replay
+    stats."""
+    if hasattr(wal, "replay_units"):
+        return replay_sharded_into(store, wal, from_seq)
     hot = getattr(store, "hot", store)
     if from_seq is None:
         from_seq = int(getattr(hot, "_wal_applied", 0))
@@ -93,7 +146,8 @@ def replay_into(store, wal, from_seq: Optional[int] = None) -> dict:
         wal.c_replayed.inc()
         n_records += 1
     # Future appends journal deltas from the replayed high-water marks.
-    hot._wal_marks = dict_sizes(hot.dicts)
+    with hot._lock:
+        hot._wal_marks = dict_sizes(hot.dicts)
     return {
         "replayed_records": n_records,
         "replayed_spans": n_spans,
@@ -128,7 +182,8 @@ def recover(checkpoint_dir: Optional[str], wal,
     if not hasattr(hot, "attach_wal"):
         raise WalReplayError(
             "recovered store does not support a write-ahead log "
-            "(single-device TpuSpanStore/TieredSpanStore only)")
+            "(TpuSpanStore/TieredSpanStore, or ShardedSpanStore with "
+            "a ShardedWal)")
     hot.attach_wal(wal)
     stats = replay_into(store, wal)
     return store, stats
