@@ -1167,6 +1167,229 @@ def run_sharded() -> dict:
         store.close()
 
 
+def run_fleet_obs() -> dict:
+    """Fleet-observability phase (r17 tentpole), proven on every CI
+    run: (a) a live primary+follower ship pair under ingest lands ONE
+    causally-linked self-trace spanning encode → WAL append → fsync →
+    ship → follower apply in the primary's own store, parent ids
+    verified; (b) the federated ``/metrics?fleet=1`` merge carries
+    both processes' samples label-distinguished with values bitwise
+    identical to each process's own scrape; (c) the stall watchdog
+    fires on an injected parked-fsync error and clears when the error
+    does; (d) self-tracing at the production sampling cadence costs
+    ≤5% ingest wall time (paired min-of-N, lineage on vs off) and adds
+    ZERO new device launches in steady state (compile-count delta 0,
+    fused-step census equality)."""
+    import os
+    import shutil
+    import tempfile
+
+    from zipkin_tpu import obs
+    from zipkin_tpu.obs import fleet as fobs
+    from zipkin_tpu.replicate import (
+        Follower,
+        ReplicaTarget,
+        ShipClient,
+        ShipServer,
+        WalShipper,
+    )
+    from zipkin_tpu.replicate.protocol import config_from_dict
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.replica import ReplicaSpanStore
+    from zipkin_tpu.store.tpu import TpuSpanStore
+    from zipkin_tpu.tracegen import ColumnarTraceGen, generate_traces
+    from zipkin_tpu.wal import WriteAheadLog
+
+    # run_replication's geometry: every ingest-step compile this phase
+    # needs is already warm by the time it runs.
+    config = dev.StoreConfig(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=128, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=512,
+    )
+    traces = generate_traces(n_traces=1000, max_depth=3, n_services=16)
+    spans = [s for t in traces for s in t][:1280]
+    chunk = 128
+    root = tempfile.mkdtemp(prefix="fleet-obs-smoke-")
+    server = None
+    follower = None
+    stores = []
+    wals = []
+    try:
+        # -- (a) live ship pair: one causally-linked trace ------------
+        reg = obs.Registry()
+        primary = TpuSpanStore(config)
+        stores.append(primary)
+        wal = WriteAheadLog(os.path.join(root, "wal-pair"), fsync="off")
+        wals.append(wal)
+        primary.attach_wal(wal)
+        tracker = fobs.LineageTracker(primary.apply, registry=reg,
+                                      sample_every=1)
+        primary.attach_lineage(tracker)
+        shipper = WalShipper(primary, registry=reg, tracker=tracker)
+        server = ShipServer(shipper, host="127.0.0.1", port=0)
+        port = server.server_address[1]
+        server.serve_in_thread()
+
+        freg = obs.Registry()
+        rc = ShipClient("127.0.0.1", port, "smoke-fleet-replica",
+                        mode="replica")
+        replica = ReplicaSpanStore(config_from_dict(
+            rc.connect()["config"]), background_compaction=False)
+        stores.append(replica)
+        flin = fobs.FollowerLineage("smoke-fleet-replica",
+                                    mode="replica", registry=freg)
+        follower = Follower(ReplicaTarget(replica), rc,
+                            registry=freg, lineage=flin)
+        for i in range(0, len(spans), chunk):
+            primary.apply(spans[i:i + chunk])
+        wal.sync()
+        deadline = time.perf_counter() + 60.0
+        while (replica.applied_seq() < wal.last_seq
+               and time.perf_counter() < deadline):
+            follower.step()
+        follower.step()  # backhaul the buffered apply spans + metrics
+        tracker.flush()
+        wal.sync()
+
+        want = {"ingest unit", "wal append", "wal fsync", "ship",
+                "replica apply"}
+        trace_roundtrip = False
+        parent_ids_ok = False
+        for itid in primary.get_trace_ids_by_name(
+                "zipkin-tpu", None, 1 << 62, 64):
+            trace = primary.get_spans_by_trace_ids([itid.trace_id])[0]
+            names = {s.name for s in trace}
+            if not (want <= names):
+                continue
+            trace_roundtrip = True
+            roots = [s for s in trace
+                     if s.name == "ingest unit" and s.parent_id is None]
+            parent_ids_ok = bool(roots) and all(
+                s.parent_id == roots[0].id
+                and s.trace_id == roots[0].trace_id
+                for s in trace if s.name in want - {"ingest unit"})
+            break
+
+        # -- (b) federation merge: bitwise vs own scrapes -------------
+        fleet = fobs.FleetObs(
+            role="primary", registry=reg, tracker=tracker,
+            remote_sources=shipper.fleet_sources,
+            replication=shipper.status)
+        fed = fleet.federated_text()
+        labels_ok = ('role="primary"' in fed
+                     and 'follower="smoke-fleet-replica"' in fed)
+
+        def _vals(text):
+            out = []
+            for line in text.splitlines():
+                if line and not line.startswith("#"):
+                    name = line.split("{")[0].split(" ")[0]
+                    out.append((name, line.rsplit(" ", 1)[1]))
+            return sorted(out)
+
+        # The follower's snapshot was pushed over FETCH meta; its
+        # samples in the merged view must format exactly as its own
+        # scrape does (values may have advanced since the push, so
+        # compare a fresh snapshot rendered through the fed path).
+        snap = fobs.registry_snapshot(freg)
+        fed_solo = fobs.render_federated([((), snap)])
+        federation_bitwise = _vals(fed_solo) == _vals(freg.render_text())
+        visible_lag_recorded = (
+            "zipkin_replication_visible_lag_seconds" in fed
+            and flin.lag_seconds() is not None)
+
+        # -- (c) watchdog fires on an injected fsync stall ------------
+        rec_ring = fobs.FlightRecorder()
+        wd = fobs.Watchdog(recorder=rec_ring, registry=reg)
+        wd.add_probe("wal_fsync", fobs.fsync_parked_probe(wal))
+        ok_before = wd.check()["ready"]
+        wal._sync_error = RuntimeError("injected fsync stall")
+        fired = wd.check()
+        wal._sync_error = None
+        cleared = wd.check()
+        watchdog_fired = (ok_before and not fired["ready"]
+                          and "injected fsync stall"
+                          in fired["reasons"][0]["reason"])
+        watchdog_cleared = bool(cleared["ready"] and len(rec_ring) == 2)
+
+        # -- (d) overhead + zero new device launches ------------------
+        def drive(store):
+            t0 = time.perf_counter()
+            for i in range(0, len(spans), chunk):
+                store.apply(spans[i:i + chunk])
+            return time.perf_counter() - t0
+
+        off = TpuSpanStore(config)
+        stores.append(off)
+        wal_off = WriteAheadLog(os.path.join(root, "wal-off"),
+                                fsync="off")
+        wals.append(wal_off)
+        off.attach_wal(wal_off)
+        on = TpuSpanStore(config)
+        stores.append(on)
+        wal_on = WriteAheadLog(os.path.join(root, "wal-on"),
+                               fsync="off")
+        wals.append(wal_on)
+        on.attach_wal(wal_on)
+        trk_on = fobs.LineageTracker(on.apply, registry=obs.Registry())
+        on.attach_lineage(trk_on)  # production cadence (1-in-64)
+        drive(off), drive(on)  # warm every pad bucket both will hit
+        compiles0 = dev.compile_count() + dev.query_compile_count()
+        t_off = min(drive(off) for _ in range(3))
+        t_on = min(drive(on) for _ in range(3))
+        lineage_compiles = (dev.compile_count()
+                            + dev.query_compile_count() - compiles0)
+        overhead_ratio = t_on / t_off if t_off > 0 else 0.0
+        def _census(store):
+            db = dev.make_device_batch(
+                *ColumnarTraceGen(store.dicts, n_services=8)
+                .next_batch(8),
+                pad_spans=512, pad_anns=1024, pad_banns=512)
+            return _count_ops(
+                dev.ingest_step.lower(store.state, db).as_text())
+
+        census_on = _census(on)
+        census_off = _census(off)
+
+        return {
+            "spans": len(spans),
+            "trace_roundtrip": bool(trace_roundtrip),
+            "parent_ids_ok": bool(parent_ids_ok),
+            "federation_labels_ok": bool(labels_ok),
+            "federation_bitwise": bool(federation_bitwise),
+            "visible_lag_recorded": bool(visible_lag_recorded),
+            "watchdog_fired": bool(watchdog_fired),
+            "watchdog_cleared": bool(watchdog_cleared),
+            "overhead_ratio": round(overhead_ratio, 4),
+            "lineage_on_s": round(t_on, 4),
+            "lineage_off_s": round(t_off, 4),
+            "lineage_steady_state_compiles": int(lineage_compiles),
+            "census_equal": census_on == census_off,
+            "fleet_processes": len(fleet.status()["processes"]),
+        }
+    finally:
+        if follower is not None:
+            follower.close()
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        for s in stores:
+            close = getattr(s, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        for w in wals:
+            try:
+                w.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_lint() -> dict:
     """graftlint phase (tier-1 gated): the concurrency/JAX-hazard
     analyzer (zipkin_tpu/analysis, docs/STATIC_ANALYSIS.md) over the
@@ -1318,6 +1541,7 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         "windows": run_windows(),
         "replication": run_replication(),
         "sharded": run_sharded(),
+        "fleet_obs": run_fleet_obs(),
         "lint": run_lint(),
         # The main stream runs the library default (window arena OFF),
         # so its step census gates at the BASE ceilings; the windows

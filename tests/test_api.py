@@ -235,6 +235,11 @@ class TestSelfTracing:
         assert status == 200 and body["traceIds"]
 
     def test_b3_continuation(self):
+        """An inbound B3 context is joined as a CHILD (r17): the
+        server span lives in the caller's trace, parented under the
+        caller's span id, with a FRESH span id of its own (the probe's
+        request and the API's server span stay distinct spans —
+        client.Tracer.resolve(child=True))."""
         store, collector, api = self._app()
         api.handle("GET", "/api/services", {},
                    headers={"X-B3-TraceId": "abcd1234",
@@ -242,13 +247,16 @@ class TestSelfTracing:
                             "X-B3-ParentSpanId": "2222"})
         collector.flush()
         spans = store.get_spans_by_trace_id(0xABCD1234)
-        assert spans and spans[0].id == 0x1111
-        assert spans[0].parent_id == 0x2222
+        assert spans
+        assert spans[0].parent_id == 0x1111
+        assert spans[0].id not in (0x1111, 0x2222)
 
     def test_response_echoes_trace_id(self):
         """Self-traced API responses echo X-B3-TraceId/-SpanId with
         exactly the ids the recorded span carries — the devtools
-        extension's contract (web/extension/)."""
+        extension's contract (web/extension/). Under child-join the
+        echoed span id is the server span's OWN (fresh) id, not the
+        caller's."""
         store, collector, api = self._app()
         resp_headers: list = []
         api.handle("GET", "/api/services", {},
@@ -256,7 +264,11 @@ class TestSelfTracing:
                    response_headers=resp_headers)
         hdr = dict(resp_headers)
         assert hdr["X-B3-TraceId"] == "beef"
-        assert hdr["X-B3-SpanId"] == "77"
+        assert hdr["X-B3-SpanId"] != "77"
+        collector.flush()
+        spans = store.get_spans_by_trace_id(0xBEEF)
+        assert spans and spans[0].id == int(hdr["X-B3-SpanId"], 16)
+        assert spans[0].parent_id == 0x77
         # Fresh trace: the echoed id is queryable afterwards.
         resp_headers = []
         api.handle("GET", "/api/services", {},

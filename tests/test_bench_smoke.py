@@ -228,6 +228,28 @@ def test_bench_smoke_json_and_op_ceilings():
     assert sh["fleet_hist_rows_bitwise"] is True, sh
     assert sh["fleet_hll_bitwise"] is True, sh
     assert sh["service_names_identical"] is True, sh
+    # Fleet-observability phase (r17 tentpole): a live primary+
+    # follower ship pair under ingest must land ONE causally-linked
+    # self-trace spanning encode → WAL append → fsync → ship →
+    # follower apply in the primary's own store with verified parent
+    # ids; the federated scrape must carry both processes label-
+    # distinguished with values bitwise identical to each process's
+    # own scrape; the watchdog must fire on an injected parked-fsync
+    # error and clear with it; and self-tracing at the production
+    # sampling cadence must cost ≤5% ingest wall time while adding
+    # ZERO new device launches (compile delta 0, step census equal).
+    fo = rec["fleet_obs"]
+    assert fo["trace_roundtrip"] is True, fo
+    assert fo["parent_ids_ok"] is True, fo
+    assert fo["federation_labels_ok"] is True, fo
+    assert fo["federation_bitwise"] is True, fo
+    assert fo["visible_lag_recorded"] is True, fo
+    assert fo["watchdog_fired"] is True, fo
+    assert fo["watchdog_cleared"] is True, fo
+    assert fo["overhead_ratio"] <= 1.05, fo
+    assert fo["lineage_steady_state_compiles"] == 0, fo
+    assert fo["census_equal"] is True, fo
+    assert fo["fleet_processes"] == 2, fo
     # graftlint phase (this PR's tentpole): the concurrency/JAX-hazard
     # analyzer must cover the whole package, find ZERO findings not in
     # the checked-in baseline, and stay inside its 30s budget (the

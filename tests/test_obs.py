@@ -126,6 +126,107 @@ class TestPrometheusText:
         assert "never_seconds_count 0" in text
 
 
+class TestFleetExposition:
+    """Federated-scrape exposition (obs/fleet.render_federated):
+    format validity of the merged ``/metrics?fleet=1`` view — one
+    HELP/TYPE per family, follower-name label escaping, and
+    counter monotonicity across successive federated scrapes."""
+
+    def _sources(self, follower="r1", inc=3):
+        from zipkin_tpu.obs.fleet import registry_snapshot
+
+        a = obs.Registry()
+        a.register(obs.Counter("fx_total", "fleet requests")).inc(inc)
+        sk = a.register(obs.LatencySketch("fx_seconds", "fleet lat"))
+        sk.observe(0.01)
+        b = obs.Registry()
+        b.register(obs.Counter("fx_total", "fleet requests")).inc(inc)
+        return a, b, [
+            ((("role", "primary"),), registry_snapshot(a)),
+            ((("role", "follower"), ("follower", follower)),
+             registry_snapshot(b)),
+        ]
+
+    def test_merged_scrape_type_help_unique(self):
+        from zipkin_tpu.obs.fleet import render_federated
+
+        _a, _b, sources = self._sources()
+        text = render_federated(sources)
+        for fam in ("fx_total", "fx_seconds"):
+            assert text.count(f"# TYPE {fam} ") == 1, fam
+            assert text.count(f"# HELP {fam} ") == 1, fam
+        # Both processes' samples survive under the one family header.
+        assert text.count("fx_total{") == 2
+
+    def test_follower_name_label_escaping(self):
+        from zipkin_tpu.obs.fleet import render_federated
+
+        _a, _b, sources = self._sources(follower='we"ird\\host\nx')
+        text = render_federated(sources)
+        assert 'follower="we\\"ird\\\\host\\nx"' in text
+        # No raw newline may leak into a sample line.
+        for line in text.splitlines():
+            if line.startswith("fx_total{"):
+                assert line.count("}") == 1
+
+    def test_counters_monotonic_across_federated_scrapes(self):
+        from zipkin_tpu.obs.fleet import (
+            registry_snapshot,
+            render_federated,
+        )
+
+        a, b, sources = self._sources()
+
+        def scrape():
+            srcs = [
+                ((("role", "primary"),), registry_snapshot(a)),
+                ((("role", "follower"), ("follower", "r1")),
+                 registry_snapshot(b)),
+            ]
+            out = {}
+            for line in render_federated(srcs).splitlines():
+                if line.startswith("fx_total{"):
+                    key, v = line.rsplit(" ", 1)
+                    out[key] = float(v)
+            return out
+
+        s1 = scrape()
+        a.get("fx_total").inc(2)
+        b.get("fx_total").inc(5)
+        s2 = scrape()
+        assert set(s1) == set(s2) and len(s1) == 2
+        for key in s1:
+            assert s2[key] >= s1[key], key
+        assert sum(s2.values()) == sum(s1.values()) + 7
+
+    def test_federated_values_bitwise_match_own_scrape(self):
+        """Every sample value in the merged view formats EXACTLY as
+        the owning process's own /metrics scrape does (same _fmt
+        path) — federation may relabel, never re-round."""
+        from zipkin_tpu.obs.fleet import (
+            registry_snapshot,
+            render_federated,
+        )
+
+        r = obs.Registry()
+        sk = r.register(obs.LatencySketch("bw_seconds", "h"))
+        for v in (0.000123, 0.37, 1.5e-5):
+            sk.observe(v)
+        def keyed(text):
+            out = set()
+            for line in text.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                name = line.split("{")[0].split(" ")[0]
+                out.add(name + "|" + line.rsplit(" ", 1)[1])
+            return out
+
+        own = keyed(r.render_text())
+        fed = keyed(render_federated(
+            [((("role", "primary"),), registry_snapshot(r))]))
+        assert own == fed
+
+
 class TestWalTelemetry:
     """The write-ahead log's metric surface (zipkin_tpu.wal): append/
     fsync sketches, segment-bytes and truncation-backlog gauges, and

@@ -111,7 +111,7 @@ class ApiServer:
                  self_trace: bool = True,
                  self_service_name: str = "zipkin-tpu",
                  registry: Optional[obs.Registry] = None,
-                 replication=None):
+                 replication=None, fleet=None):
         self.query = query
         self.collector = collector
         self.pin_ttl_s = pin_ttl_s
@@ -119,6 +119,12 @@ class ApiServer:
         # primary's WalShipper.status or a follower's Follower.status
         # (docs/REPLICATION.md); None answers {"role": "none"}.
         self.replication = replication
+        # Fleet observability hub (obs.fleet.FleetObs): serves
+        # /api/health (watchdog readiness), /api/fleet (merged roll-up
+        # status), /debug/events (flight recorder) and the federated
+        # /metrics?fleet=1 view; None degrades each to its
+        # single-process answer (docs/OBSERVABILITY.md).
+        self.fleet = fleet
         self.registry = registry or obs.default_registry()
         # Query-stage latency sketch: p50/p99 per normalized route
         # (moments + log-histogram, see obs.LatencySketch).
@@ -279,16 +285,31 @@ class ApiServer:
         # (the devtools extension's signal, web/extension/) with
         # exactly the ids the recorded span carries — the one contract
         # site is Tracer.resolve (unsampled requests echo only
-        # X-B3-Sampled: 0, never a dead trace link).
-        resolved = self.tracer.resolve(b3)
+        # X-B3-Sampled: 0, never a dead trace link). child=True: an
+        # inbound B3 context is JOINED as a proper child span (fresh
+        # id, parent = the caller's span id) instead of the legacy
+        # shared-span reuse, so external probes and the web UI see the
+        # API's server span as a distinct hop in their own trace.
+        resolved = self.tracer.resolve(b3, child=True)
         if response_headers is not None:
             response_headers.extend(resolved.emit().items())
         start_us = int(_time.time() * 1e6)
         status = 500
+        token = None
+        if resolved.trace_id is not None:
+            # Publish this request's (trace, span) to the thread/task
+            # context so downstream shared work — the cross-shard
+            # dispatcher's fused launches — can parent spans under it.
+            from zipkin_tpu.obs import fleet as _fleet
+
+            token = _fleet.set_request_context(resolved.trace_id,
+                                               resolved.span_id)
         try:
             status, payload = self._dispatch(method, path, params, body)
             return status, payload
         finally:
+            if token is not None:
+                _fleet.reset_request_context(token)
             self.tracer.server_span(
                 f"{method.lower()} {path}", resolved,
                 start_us=start_us, end_us=int(_time.time() * 1e6),
@@ -322,11 +343,38 @@ class ApiServer:
                                     web.index_html())
         if path == "/health":
             return 200, {"status": "ok"}
+        if path == "/api/health":
+            # Watchdog-backed liveness/readiness with reasons
+            # (docs/OBSERVABILITY.md runbook). Without a fleet hub the
+            # process is trivially ready — /health's contract with a
+            # structured body.
+            if self.fleet is None:
+                return 200, {"live": True, "ready": True, "reasons": []}
+            h = self.fleet.health()
+            return (200 if h.get("ready") else 503), h
+        if path == "/api/fleet":
+            if self.fleet is None:
+                return 200, {"role": "none"}
+            return 200, self.fleet.status()
+        if path == "/debug/events":
+            limit = params.get("limit")
+            events = ([] if self.fleet is None
+                      else self.fleet.events(int(limit) if limit
+                                             else None))
+            return 200, {"events": events}
         if path == "/metrics":
             # Prometheus text exposition by default; the legacy JSON
             # dict stays at ?format=json (docs/MIGRATION.md).
             if params.get("format") == "json":
                 return 200, self._metrics()
+            if params.get("fleet") and self.fleet is not None:
+                # Federated scrape: this process's registry plus every
+                # pushed follower/shard snapshot, label-distinguished
+                # (obs.fleet.render_federated — no double counting).
+                return 200, RawResponse(
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    self.fleet.federated_text().encode("utf-8"),
+                )
             return 200, RawResponse(
                 "text/plain; version=0.0.4; charset=utf-8",
                 self.registry.render_text().encode("utf-8"),
@@ -729,7 +777,8 @@ _KNOWN_ROUTES = frozenset((
     "/api/quantiles", "/api/dependencies", "/api/traces_exist",
     "/api/span_durations", "/api/service_names_to_trace_ids",
     "/api/data_ttl", "/api/windowed_quantiles", "/api/slo_burn",
-    "/api/latency_heatmap", "/api/replication", "/scribe",
+    "/api/latency_heatmap", "/api/replication", "/api/health",
+    "/api/fleet", "/debug/events", "/scribe",
 ))
 
 

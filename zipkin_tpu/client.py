@@ -106,17 +106,35 @@ class Tracer:
             return b3.sampled
         return self.rng.random() < self.sample_rate
 
-    def resolve(self, b3: B3Headers) -> B3Headers:
+    def resolve(self, b3: B3Headers, child: bool = False) -> B3Headers:
         """Pin the ids and sampling decision for one server request —
         THE single place the echo/record contract lives: the resolved
         headers are what the response echoes (so the devtools
         extension links real traces) and exactly what server_span
         records. Unsampled requests resolve with ids=None: nothing
         will be recorded, so echoing a trace id would hand out dead
-        links — only X-B3-Sampled: 0 is emitted for them."""
+        links — only X-B3-Sampled: 0 is emitted for them.
+
+        ``child=False`` (the default) is the classic shared-span
+        model: an inbound span id is REUSED, so the server span and
+        the caller's client span are the same id (finagle-era B3).
+        ``child=True`` joins the caller's trace as a proper CHILD:
+        a fresh span id parented under the inbound span id — what
+        the fleet self-tracing uses so an external probe's request
+        and the API's own server span stay distinct spans in one
+        trace. Without inbound ids the two modes are identical (a
+        fresh root either way)."""
         sampled = self.should_sample(b3)
         if not sampled:
             return B3Headers(sampled=False)
+        if child and b3.span_id is not None:
+            return B3Headers(
+                trace_id=(b3.trace_id if b3.trace_id is not None
+                          else _new_id(self.rng)),
+                span_id=_new_id(self.rng),
+                parent_id=b3.span_id,
+                sampled=True,
+            )
         return B3Headers(
             trace_id=(b3.trace_id if b3.trace_id is not None
                       else _new_id(self.rng)),

@@ -71,6 +71,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the per-ingest-step zipkin-tpu self "
                         "spans (API-request self-tracing stays on; "
                         "see docs/OBSERVABILITY.md)")
+    p.add_argument("--no-fleet-obs", action="store_true",
+                   help="disable the fleet-observability surface: "
+                        "batch-lineage tracing (WAL-stamped causal "
+                        "spans across ship/apply), metrics federation "
+                        "(/metrics?fleet=1, /api/fleet), and the stall "
+                        "watchdog behind /api/health + /debug/events "
+                        "(docs/OBSERVABILITY.md)")
+    p.add_argument("--lineage-sample-every", type=int, default=0,
+                   help="trace 1-in-N launch units end-to-end through "
+                        "WAL append → fsync → ship → follower apply "
+                        "(0 = the default 64; 1 traces every unit — "
+                        "bench/debug only)")
     p.add_argument("--cold-tier", action="store_true",
                    help="capture ring evictions into the compressed "
                         "segment archive and federate queries across "
@@ -327,6 +339,39 @@ def build_app(args):
         self_trace=not args.no_self_trace_ingest,
         pipeline_depth=args.pipeline_depth,
     )
+    tracker = None
+    watchdog = None
+    recorder = None
+    if not args.no_fleet_obs:
+        from zipkin_tpu import obs
+        from zipkin_tpu.obs import fleet as fobs
+
+        reg = obs.default_registry()
+        # Batch-lineage tracing: spans land through store.apply so they
+        # live in the system's own store (and ride the WAL/ship path
+        # like any span). attach_lineage is a no-op journal-wise until
+        # a single-log WAL is attached; the sharded group-commit log
+        # does not stamp lineage yet, but the tracker still collects
+        # dispatcher + API-parented spans there.
+        tracker = fobs.LineageTracker(
+            store.apply, registry=reg,
+            sample_every=args.lineage_sample_every or None)
+        if hasattr(hot, "attach_lineage"):
+            hot.attach_lineage(tracker)
+        disp = getattr(hot, "dispatcher", None)
+        if disp is not None:
+            disp.span_sink = tracker
+        recorder = fobs.FlightRecorder()
+        watchdog = fobs.Watchdog(recorder=recorder, registry=reg)
+        watchdog.add_probe("pipeline", fobs.pipeline_stall_probe(hot))
+        watchdog.add_probe("sealer", fobs.sealer_backlog_probe(hot))
+        wal_obj = getattr(store, "wal", None)
+        if wal_obj is not None and hasattr(wal_obj, "sync_error"):
+            watchdog.add_probe("wal_fsync",
+                               fobs.fsync_parked_probe(wal_obj))
+        if disp is not None:
+            watchdog.add_probe("dispatcher",
+                               fobs.dispatcher_stuck_probe(disp))
     shipper = None
     if args.ship_port:
         if getattr(store, "wal", None) is None:
@@ -334,12 +379,38 @@ def build_app(args):
                              "WAL records are what gets shipped)")
         from zipkin_tpu.replicate import WalShipper
 
-        shipper = WalShipper(store)
+        shipper = WalShipper(store, tracker=tracker)
+        if watchdog is not None:
+            from zipkin_tpu.obs import fleet as fobs
+
+            def _worst_follower_lag():
+                st = shipper.status()
+                lags = [f["lagRecords"]
+                        for f in st.get("followers", {}).values()]
+                return {"lagRecords": max(lags) if lags else 0}
+
+            watchdog.add_probe(
+                "follower_lag",
+                fobs.follower_lag_probe(_worst_follower_lag))
+    fleet = None
+    if not args.no_fleet_obs:
+        from zipkin_tpu import obs
+        from zipkin_tpu.obs import fleet as fobs
+
+        fleet = fobs.FleetObs(
+            role="primary", registry=obs.default_registry(),
+            tracker=tracker, watchdog=watchdog, recorder=recorder,
+            remote_sources=(shipper.fleet_sources
+                            if shipper is not None else None),
+            replication=(shipper.status
+                         if shipper is not None else None),
+        )
     window_s = (args.query_window_ms / 1000.0
                 if args.query_window_ms is not None else None)
     api = ApiServer(
         QueryService(store, coalesce_window_s=window_s), collector,
         replication=shipper.status if shipper is not None else None,
+        fleet=fleet,
     )
     return store, collector, api, shipper
 
@@ -392,13 +463,34 @@ def build_follower_app(args):
 
         store = ReplicaSpanStore(config)
         target = ReplicaTarget(store)
+    lineage = None
+    fleet = None
+    if not args.no_fleet_obs:
+        from zipkin_tpu import obs
+        from zipkin_tpu.obs import fleet as fobs
+
+        reg = obs.default_registry()
+        lineage = fobs.FollowerLineage(name, mode=args.follow_mode,
+                                       registry=reg)
     follower = Follower(target, client,
-                        poll_interval_s=args.follow_poll_ms / 1000.0)
+                        poll_interval_s=args.follow_poll_ms / 1000.0,
+                        lineage=lineage)
+    if lineage is not None:
+        recorder = fobs.FlightRecorder()
+        watchdog = fobs.Watchdog(recorder=recorder, registry=reg)
+        watchdog.add_probe("replication_lag",
+                           fobs.follower_lag_probe(follower.status))
+        fleet = fobs.FleetObs(
+            role=args.follow_mode, name=name, registry=reg,
+            follower=lineage, watchdog=watchdog, recorder=recorder,
+            replication=follower.status,
+        )
     window_s = (args.query_window_ms / 1000.0
                 if args.query_window_ms is not None else None)
     api = ApiServer(
         QueryService(store, coalesce_window_s=window_s), None,
         replication=follower.status,
+        fleet=fleet,
     )
     return store, follower, api
 
@@ -574,6 +666,15 @@ def main(argv=None) -> None:
         collector.close()
         if shipper is not None:
             shipper.close()
+        if api.fleet is not None and api.fleet.tracker is not None:
+            # Flush buffered lineage spans before the WAL's final
+            # fsync so the self-trace tail is durable too.
+            try:
+                api.fleet.tracker.flush()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
         wal = getattr(store, "wal", None)
         if wal is not None:
             wal.close()

@@ -22,8 +22,24 @@ Components take a ``registry`` argument defaulting to the process-wide
 instance (``default_registry()``); registering a name twice replaces
 the earlier metric (newest pipeline object wins — the earlier one keeps
 counting into its own, now-unscraped, object).
+
+The fleet layer (``obs.fleet``, r17) extends all three consumers
+across process boundaries: causal self-tracing over the ship
+protocol, pushed-snapshot metrics federation (``/metrics?fleet=1``),
+and the stall watchdog + flight recorder behind ``/api/health`` /
+``/debug/events``.
 """
 
+from zipkin_tpu.obs.fleet import (
+    FleetObs,
+    FlightRecorder,
+    FollowerLineage,
+    LineageTracker,
+    Watchdog,
+    merge_sketches,
+    registry_snapshot,
+    render_federated,
+)
 from zipkin_tpu.obs.registry import (
     CallbackFamily,
     Counter,
@@ -36,8 +52,16 @@ from zipkin_tpu.obs.registry import (
 __all__ = [
     "CallbackFamily",
     "Counter",
+    "FleetObs",
+    "FlightRecorder",
+    "FollowerLineage",
     "Gauge",
     "LatencySketch",
+    "LineageTracker",
     "Registry",
+    "Watchdog",
     "default_registry",
+    "merge_sketches",
+    "registry_snapshot",
+    "render_federated",
 ]

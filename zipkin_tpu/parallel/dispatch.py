@@ -45,9 +45,15 @@ from typing import List, Optional
 
 
 class _Req:
-    """One caller's request + its rendezvous state."""
+    """One caller's request + its rendezvous state. ``ctx`` is the
+    submitting thread's (trace_id, span_id) request context
+    (obs.fleet.current_request_context) — the dispatcher's fused
+    launch span parents under it, so an API read that rode a shared
+    collective shows the shared launch as a child span. ``t_enq`` is
+    the enqueue timestamp the stuck-queue watchdog ages against."""
 
-    __slots__ = ("kind", "payload", "result", "error", "done")
+    __slots__ = ("kind", "payload", "result", "error", "done", "ctx",
+                 "t_enq")
 
     def __init__(self, kind: str, payload):
         self.kind = kind  # "cat" | "ids"
@@ -55,6 +61,8 @@ class _Req:
         self.result = None
         self.error = None
         self.done = False
+        self.ctx = None
+        self.t_enq = 0.0
 
 
 class CrossShardDispatcher:
@@ -86,6 +94,12 @@ class CrossShardDispatcher:
             "zipkin_shard_dispatch_batch_size",
             "Concurrent sharded reads sharing one dispatcher batch",
             min_value=1.0))
+        # Self-trace sink (obs.fleet.LineageTracker or None): when set,
+        # each executed batch records a "shard dispatch" span parented
+        # under the first rider's request context — the causal link
+        # from an API read to the fused collective launch it shared.
+        self.span_sink = None
+        self._busy_since = 0.0  # guarded-by: _cv (0.0 = idle)
         # Started lazily: a store constructed for a handful of reads
         # never pays a standing thread it didn't use.
         self._thread: Optional[threading.Thread] = None
@@ -103,6 +117,11 @@ class CrossShardDispatcher:
         return self._submit(_Req("ids", query))
 
     def _submit(self, req: _Req):
+        if self.span_sink is not None:
+            from zipkin_tpu.obs import fleet as _fleet
+
+            req.ctx = _fleet.current_request_context()
+        req.t_enq = time.monotonic()
         with self._cv:
             closed = self._closed
             reentrant = threading.current_thread() is self._thread
@@ -151,11 +170,13 @@ class CrossShardDispatcher:
             with self._cv:
                 batch, self._pending = self._pending, []
                 self._inflight = len(batch)
+                self._busy_since = time.monotonic()
             try:
                 self._execute(batch)
             finally:
                 with self._cv:
                     self._inflight = 0
+                    self._busy_since = 0.0
                     self._cv.notify_all()
 
     def _execute(self, batch: List[_Req]) -> None:
@@ -167,6 +188,7 @@ class CrossShardDispatcher:
         cat_reqs = [r for r in batch if r.kind == "cat"]
         ids_reqs = [r for r in batch if r.kind == "ids"]
         saved = 0
+        t_exec0 = time.perf_counter()
         if cat_reqs:
             try:
                 fused = (len(cat_reqs) >= 2 and all(
@@ -220,6 +242,28 @@ class CrossShardDispatcher:
             self.max_batch = max(self.max_batch, len(batch))
             self._cv.notify_all()
         self._h_size.observe(max(len(batch), 1))
+        sink = self.span_sink
+        if sink is not None:
+            # One span per executed batch, parented under the first
+            # rider that carried a request context — the other riders
+            # are listed in the tags rather than given duplicate spans
+            # (a fused launch IS one unit of work).
+            ctx = next((r.ctx for r in batch if r.ctx is not None),
+                       None)
+            if ctx is not None:
+                dur_us = max(
+                    1, int((time.perf_counter() - t_exec0) * 1e6))
+                try:
+                    sink.record_span(
+                        ctx[0], ctx[1], "shard dispatch",
+                        int(time.time() * 1e6) - dur_us, dur_us,
+                        {"dispatch.batch": str(len(batch)),
+                         "dispatch.cat": str(len(cat_reqs)),
+                         "dispatch.ids": str(len(ids_reqs)),
+                         "dispatch.saved": str(saved)})
+                except Exception:  # graftlint: disable=swallowed-exception
+                    pass  # tracing is advisory — a sink failure must
+                    # never fail the query batch it annotates
 
     # -- lifecycle -------------------------------------------------------
 
@@ -245,6 +289,21 @@ class CrossShardDispatcher:
     def closed(self) -> bool:
         with self._cv:
             return self._closed
+
+    def queue_age_s(self) -> float:
+        """Age of the dispatcher's oldest unfinished work: seconds the
+        oldest pending request has waited, or seconds the in-flight
+        batch has been executing — whichever is older; 0.0 when idle.
+        The stuck-queue watchdog signal (obs.fleet): a healthy
+        dispatcher turns batches over in one launch time."""
+        now = time.monotonic()
+        with self._cv:
+            age = 0.0
+            if self._pending:
+                age = now - min(r.t_enq for r in self._pending)
+            if self._inflight and self._busy_since:
+                age = max(age, now - self._busy_since)
+            return max(0.0, age)
 
     def stats(self) -> dict:
         with self._cv:

@@ -71,13 +71,19 @@ class ShipClient:
         return msg
 
     def fetch(self, cursor: int, max_bytes: int = 8 << 20,
-              ack: Optional[int] = None):
+              ack: Optional[int] = None,
+              extra_meta: Optional[dict] = None):
         """(records, last_seq, durable_seq) or None when the primary
         says the cursor needs an anchor bootstrap. ``ack`` moves the
-        retention pin (defaults to cursor server-side)."""
+        retention pin (defaults to cursor server-side).
+        ``extra_meta`` merges fleet-observability ride-alongs into the
+        FETCH frame (``spans`` backhaul, ``metrics`` snapshot — see
+        replicate/protocol.py); an older primary ignores them."""
         meta = {"cursor": int(cursor), "max_bytes": int(max_bytes)}
         if ack is not None:
             meta["ack"] = int(ack)
+        if extra_meta:
+            meta.update(extra_meta)
         msg_type, meta, blob = self._roundtrip(
             P.encode_msg(P.FETCH, meta))
         if msg_type == P.NEED_ANCHOR:
@@ -178,11 +184,17 @@ class Follower:
     def __init__(self, target, client: ShipClient,
                  poll_interval_s: float = 0.02,
                  max_fetch_bytes: int = 8 << 20,
-                 registry=None):
+                 registry=None, lineage=None):
         from zipkin_tpu import obs
 
         self.target = target
         self.client = client
+        # Fleet-observability half (obs.fleet.FollowerLineage): times
+        # each record's apply against its shipped commit timestamp
+        # (lag seconds), buffers apply spans for the FETCH backhaul,
+        # and throttles metric snapshots for federation. None = the
+        # pre-r17 wire behavior, byte for byte.
+        self.lineage = lineage
         self.poll_interval_s = max(1e-3, float(poll_interval_s))
         self.max_fetch_bytes = int(max_fetch_bytes)
         self._stop = threading.Event()
@@ -244,6 +256,8 @@ class Follower:
             "lagRecords": max(0, durable - self.target.applied_seq()),
             "fetchedBytes": fetched,
             "appliedRecords": applied_n,
+            "lagSeconds": (self.lineage.lag_seconds()
+                           if self.lineage is not None else None),
             "error": repr(err) if err is not None else None,
         }
 
@@ -289,9 +303,20 @@ class Follower:
         the tests share it). Returns True when records were applied."""
         cursor = self.target.applied_seq()
         ack_fn = getattr(self.target, "ack_seq", None)
+        extra = None
+        lin = self.lineage
+        if lin is not None:
+            extra = {}
+            spans = lin.take_spans()
+            if spans:
+                extra["spans"] = spans
+            snap = lin.maybe_metrics_snapshot()
+            if snap is not None:
+                extra["metrics"] = snap
         got = self.client.fetch(
             cursor, self.max_fetch_bytes,
-            ack=ack_fn() if ack_fn is not None else None)
+            ack=ack_fn() if ack_fn is not None else None,
+            extra_meta=extra or None)
         if got is None:
             # Cursor precedes the retained log: bootstrap. "AHEAD of
             # the primary" is judged against the FRESHEST last_seq we
@@ -318,8 +343,12 @@ class Follower:
             self._error = None
         nbytes = 0
         for seq, payload in records:
+            t0 = time.perf_counter()
             self.target.apply(seq, payload)
             nbytes += len(payload)
+            if lin is not None:
+                lin.observe_record(seq, payload,
+                                   time.perf_counter() - t0)
         if records:
             self.c_applied.inc(len(records))
             self.c_fetched.inc(nbytes)

@@ -38,6 +38,20 @@ registered follower's DURABLE frontier. RECORDS only ever
 carries records at or below the primary's DURABLE frontier — a
 follower can never apply what the primary could still lose, which is
 what makes "un-acked tail absent in full" hold across the pair.
+
+Fleet-observability ride-alongs (r17, all OPTIONAL meta keys an older
+peer simply ignores — the codec passes unknown keys through):
+
+- FETCH may carry ``spans`` (a list of wire-form self-trace spans,
+  obs.fleet.span_to_wire) — the follower's apply spans backhauled to
+  the primary, which owns the writable store and stitches them into
+  the batch-lineage trace; and ``metrics`` (a registry snapshot,
+  obs.fleet.registry_snapshot, throttled to ~1/s) — the follower's
+  half of the ``/metrics?fleet=1`` federation.
+- Record PAYLOADS may carry lineage meta (``ts``, sampled ``b3``) in
+  their WAL json header (wal/record.encode_unit extra); followers
+  read them with wal/record.unit_meta. Replay ignores the keys, so
+  shipped bytes stay bitwise-deterministic inputs to apply.
 """
 
 from __future__ import annotations

@@ -38,7 +38,7 @@ from zipkin_tpu.replicate import protocol as P
 class WalShipper:
     """See the module docstring. One instance per primary process."""
 
-    def __init__(self, store, wal=None, registry=None):
+    def __init__(self, store, wal=None, registry=None, tracker=None):
         from zipkin_tpu import obs
 
         self.store = store
@@ -48,11 +48,19 @@ class WalShipper:
             raise ValueError(
                 "WAL shipping needs a WriteAheadLog attached to the "
                 "primary store (--wal-dir)")
+        # Batch-lineage tracker (obs.fleet.LineageTracker): fetch()
+        # reports each shipped sampled record ("ship" child span) and
+        # stitches the follower's backhauled apply spans into the
+        # primary's own trace store. None = tracing off.
+        self.tracker = tracker
         # Follower bookkeeping only — WAL calls happen OUTSIDE the
         # hold (the cursor pin itself lives in the WAL, under its own
         # condition).
         self._lock = threading.Lock()  # lock-order: 79 ship-followers
         self._followers: Dict[str, dict] = {}  # guarded-by: _lock
+        # Latest pushed registry snapshot per follower (the FETCH
+        # "metrics" ride-along) — the federation's remote sources.
+        self._follower_metrics: Dict[str, dict] = {}  # guarded-by: _lock
         reg = registry or obs.default_registry()
         self._registry = reg
         self.c_bytes = reg.register(obs.Counter(
@@ -101,17 +109,29 @@ class WalShipper:
         }
 
     def fetch(self, follower: str, cursor: int, max_bytes: int,
-              ack: Optional[int] = None):
+              ack: Optional[int] = None, spans=None, metrics=None):
         """(records, last_seq, durable_seq) past ``cursor`` — or None
         when the cursor precedes the retained log (anchor needed).
         ``ack`` is the follower's LOCALLY-DURABLE frontier and is what
         moves its retention pin (defaults to the cursor — right for a
         replica, which re-anchors after total loss; a warm standby
         acks its checkpointed frontier so a crash can always re-replay
-        the gap from the log)."""
+        the gap from the log).
+
+        ``spans``/``metrics`` are the FETCH frame's observability
+        ride-alongs (replicate/protocol.py): backhauled follower apply
+        spans get stitched into the primary's lineage trace, and the
+        pushed registry snapshot becomes the follower's column of the
+        federated ``/metrics?fleet=1`` view."""
         cursor = max(0, int(cursor))
         ack = cursor if ack is None else max(0, int(ack))
         self.wal.advance_cursor(follower, ack)
+        trk = self.tracker
+        if trk is not None and spans:
+            trk.ingest_remote_spans(follower, spans)
+        if metrics is not None:
+            with self._lock:
+                self._follower_metrics[follower] = metrics
         first = self.wal.first_available_seq()
         if cursor + 1 < first:
             return None
@@ -125,6 +145,9 @@ class WalShipper:
             nbytes += len(payload)
             if nbytes >= max_bytes:
                 break
+        if trk is not None:
+            for seq, _payload in records:
+                trk.note_shipped(seq, follower)
         self.c_records.inc(len(records))
         self.c_bytes.inc(nbytes)
         with self._lock:
@@ -168,6 +191,19 @@ class WalShipper:
         self.wal.drop_cursor(follower)
         with self._lock:
             self._followers.pop(follower, None)
+            self._follower_metrics.pop(follower, None)
+
+    def fleet_sources(self):
+        """The federation's remote half: one ((label, value), ...)
+        + registry-snapshot pair per follower that has pushed metrics
+        (obs.fleet.render_federated's ``sources`` shape, minus the
+        primary's own row — FleetObs prepends that)."""
+        with self._lock:
+            snaps = sorted(self._follower_metrics.items())
+        return [
+            ((("role", "follower"), ("follower", name)), snap)
+            for name, snap in snaps
+        ]
 
     def status(self) -> dict:
         durable = self.wal.durable_seq
@@ -227,7 +263,9 @@ class _ShipHandler(socketserver.BaseRequestHandler):
                         got = shipper.fetch(
                             follower, int(meta.get("cursor", 0)),
                             int(meta.get("max_bytes", 8 << 20)),
-                            ack=None if ack is None else int(ack))
+                            ack=None if ack is None else int(ack),
+                            spans=meta.get("spans"),
+                            metrics=meta.get("metrics"))
                         if got is None:
                             out = P.encode_msg(P.NEED_ANCHOR, {
                                 "first_seq":

@@ -377,6 +377,12 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         self.wal: Optional[WriteAheadLog] = None
         self._wal_applied = 0
         self._wal_marks = None
+        # Batch lineage tracker (obs.fleet.LineageTracker): when
+        # attached, _journal_group stamps each record's meta with a
+        # commit timestamp (+ a sampled B3 context) and reports the
+        # append so the unit's WAL append → fsync → ship → follower
+        # apply shows up as one causally-linked self-trace.
+        self.lineage = None
         # Host sketch mirror (store/mirror.SketchMirror): numpy twins
         # of the device's lifetime aggregate arrays AND the windowed
         # Moments-sketch arena, updated by each commit's delta inside
@@ -1252,18 +1258,49 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         with self._lock:
             self.wal = wal
             self._wal_marks = dict_sizes(self.dicts)
+            if self.lineage is not None:
+                wal.set_on_durable(self.lineage.on_durable)
+
+    def attach_lineage(self, tracker) -> None:
+        """Stamp every journaled launch group with lineage meta
+        (obs.fleet.LineageTracker) and report its append/fsync
+        progress to the tracker. Host-side only: stamps ride the WAL
+        record's json header, which replay ignores — the device write
+        path and step census are untouched. Order-independent with
+        ``attach_wal``."""
+        with self._lock:
+            self.lineage = tracker
+            if self.wal is not None:
+                self.wal.set_on_durable(tracker.on_durable)
 
     def _journal_group(self, group) -> int:
         """Append one planned launch group (+ the dictionary entries
         its encode step added) to the WAL; returns the record's
         sequence. Runs on the encoding thread under self._lock, so
         append order == encode order == commit order — the property
-        replay's dictionary-delta chain depends on."""
+        replay's dictionary-delta chain depends on.
+
+        With a lineage tracker attached the record meta gains the
+        commit timestamp (+ sampled B3 context) and the append is
+        reported. The append runs inside ``tracker.suppressed()``:
+        with fsync=off/batch the WAL's on_durable callback fires
+        synchronously in ``wal.append`` while THIS thread holds the
+        store's encode lock — a tracker flush there would re-enter
+        ``store.apply`` and deadlock; suppression defers it to the
+        next out-of-lock flush site."""
         from zipkin_tpu.wal.record import dump_dict_deltas, encode_unit
 
         sizes, deltas = dump_dict_deltas(self.dicts, self._wal_marks)
-        seq = self.wal.append(encode_unit(group, self._wal_marks,
-                                          deltas))
+        lin = self.lineage
+        if lin is not None:
+            extra = lin.stamp()
+            with lin.suppressed():
+                seq = self.wal.append(encode_unit(
+                    group, self._wal_marks, deltas, extra=extra))
+            lin.note_append(seq, extra)
+        else:
+            seq = self.wal.append(encode_unit(group, self._wal_marks,
+                                              deltas))
         self._wal_marks = sizes
         return seq
 
@@ -1327,6 +1364,16 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         err = p.take_error()
         if raise_errors and err is not None:
             raise err
+
+    def ingest_pipeline(self) -> Optional[IngestPipeline]:
+        """The running ingest pipeline, or None on the serial path —
+        the stall watchdog's probe handle (obs.fleet)."""
+        return self._pipeline
+
+    def eviction_sealer(self):
+        """The async capture sealer, or None when sealing is inline —
+        the backlog watchdog's probe handle (obs.fleet)."""
+        return self._sealer
 
     @contextlib.contextmanager
     def pipelined(self, depth: Optional[int] = None):

@@ -264,6 +264,12 @@ class WriteAheadLog:
         # they waited) from "stale error, retry thread merely starved".
         self._sync_error: Optional[BaseException] = None  # guarded-by: _cond
         self._sync_fails = 0  # guarded-by: _cond
+        # Durable-frontier observer (obs.fleet lineage): called with
+        # the new durable seq AFTER _cond is released at every site
+        # that advances the frontier. Must never be invoked under
+        # _cond — the callback flushes self-trace spans through
+        # store.apply, whose lock ranks BELOW the WAL's (10 < 60).
+        self._on_durable = None  # guarded-by: _cond (the slot, not the call)
         self.torn_records_cut = 0  # records dropped by the open() scan
         self._next_seq = 1  # guarded-by: _cond
         self._durable = 0  # guarded-by: _cond
@@ -412,6 +418,35 @@ class WriteAheadLog:
         with self._cond:
             return dict(self._cursors)
 
+    # -- fleet observability hooks --------------------------------------
+
+    def set_on_durable(self, fn) -> None:
+        """Register ``fn(durable_seq)`` to run after every durable-
+        frontier advance, OUTSIDE ``_cond``. With ``fsync='off'`` or
+        ``'batch'`` the call happens synchronously inside ``append``
+        (the caller may hold its own locks — obs.fleet's tracker
+        defers its flush via ``suppressed()`` for exactly this case);
+        under ``'interval'`` it runs on the group-commit thread."""
+        with self._cond:
+            self._on_durable = fn
+
+    def _notify_durable(self, prev: int) -> None:
+        """Fire the durable observer if the frontier moved past
+        ``prev``. Called WITHOUT _cond held."""
+        with self._cond:
+            fn, now = self._on_durable, self._durable
+        if fn is not None and now > prev:
+            try:
+                fn(now)
+            except Exception:  # graftlint: disable=swallowed-exception
+                pass  # the observer must not poison the append/fsync path
+
+    def sync_error(self) -> Optional[BaseException]:
+        """The parked group-commit fsync failure, or None when the
+        last fsync succeeded — the stall watchdog's fsync probe."""
+        with self._cond:
+            return self._sync_error
+
     # -- append path ----------------------------------------------------
 
     def _ensure_file_locked(self):  # called-under: _cond
@@ -484,12 +519,14 @@ class WriteAheadLog:
             seg.nbytes += len(frame)
             seq = self._next_seq
             self._next_seq += 1
+            prev_durable = self._durable
             if self.fsync == FsyncPolicy.BATCH:
                 self._fsync_locked()
             elif self.fsync == FsyncPolicy.OFF:
                 self._durable = seq
                 self._cond.notify_all()
             # INTERVAL: the group-commit thread advances the frontier.
+        self._notify_durable(prev_durable)
         self.h_append.observe(time.perf_counter() - t0)
         self.c_records.inc()
         return seq
@@ -509,6 +546,12 @@ class WriteAheadLog:
         not depend on the steady-state policy)."""
         with self._cond:
             self._fsync_locked()
+        # Always notify (prev=-1): under fsync='off' the frontier was
+        # already at the append frontier, but lineage seqs registered
+        # AFTER their append's own notification (note_append runs once
+        # append returns) still need a durable callback — sync() is
+        # the explicit barrier that drains them.
+        self._notify_durable(-1)
 
     def wait_durable(self, seq: int, timeout: Optional[float] = 30.0
                      ) -> bool:
@@ -597,10 +640,12 @@ class WriteAheadLog:
                 else:
                     self.h_fsync.observe(time.perf_counter() - t0)
                     with self._cond:
+                        prev = self._durable
                         self._sync_error = None
                         if target > self._durable:
                             self._durable = target
                         self._cond.notify_all()
+                    self._notify_durable(prev)
                 finally:
                     os.close(fd)
             time.sleep(self.interval_s)

@@ -142,9 +142,18 @@ def apply_dict_deltas(dicts, before: Sequence[int],
 
 
 def encode_unit(group, before: Sequence[int],
-                deltas: Dict[str, list]) -> bytes:
+                deltas: Dict[str, list],
+                extra: Dict[str, object] = None) -> bytes:
     """One launch group (list of (SpanBatch, name_lc, indexable)) plus
-    its dictionary delta → record payload bytes."""
+    its dictionary delta → record payload bytes.
+
+    ``extra`` merges additional meta keys into the record header —
+    lineage keys the fleet-observability layer stamps (``ts``: commit
+    timestamp µs, ``b3``: [trace_id, span_id] of the sampled launch
+    unit's self-trace). ``decode_unit`` ignores unknown keys, so
+    stamped and unstamped records replay identically; the keys ride
+    the shipped payload to followers, who read them via
+    ``unit_meta``."""
     parts_meta = []
     blobs: List[bytes] = []
     for batch, name_lc, indexable in group:
@@ -158,12 +167,26 @@ def encode_unit(group, before: Sequence[int],
             cols.append([col, arr.dtype.str, int(arr.shape[0])])
             blobs.append(arr.tobytes())
         parts_meta.append(cols)
-    meta = json.dumps(
-        {"v": 1, "before": list(map(int, before)), "deltas": deltas,
-         "parts": parts_meta},
-        separators=(",", ":"),
-    ).encode("utf-8")
+    head = {"v": 1, "before": list(map(int, before)), "deltas": deltas,
+            "parts": parts_meta}
+    if extra:
+        for k in ("v", "before", "deltas", "parts"):
+            if k in extra:
+                raise ValueError(f"extra meta key {k!r} shadows the "
+                                 f"record header")
+        head.update(extra)
+    meta = json.dumps(head, separators=(",", ":")).encode("utf-8")
     return _LEN.pack(len(meta)) + meta + b"".join(blobs)
+
+
+def unit_meta(payload: bytes) -> Dict[str, object]:
+    """Record payload → its json meta header alone (no column blobs
+    decoded). Followers use this to read the lineage keys (``ts``,
+    ``b3``) off a shipped record without paying a second columnar
+    decode."""
+    (mlen,) = _LEN.unpack_from(payload, 0)
+    return json.loads(payload[_LEN.size:_LEN.size + mlen]
+                      .decode("utf-8"))
 
 
 def decode_unit(payload: bytes):
