@@ -1288,6 +1288,209 @@ def bench_windows(total_spans: int = 200_000):
     return out
 
 
+def bench_paged(total_spans: int = 100_000):
+    """Paged-layout phase (r19 tentpole, store/paged): the end of the
+    skew tax. Trace sizes in production are zipf — 1-span polls next
+    to 10k-span batch jobs — and a FIFO ring must over-provision for
+    the p99 trace because a long-running trace's early spans get
+    overwritten by unrelated churn, leaving partial traces that
+    occupy rows yet answer no complete-trace query. The paged layout
+    reclaims at page granularity with trace-granular LRW (a writing
+    trace keeps its whole chain fresh), so active traces stay WHOLE.
+
+    Arms:
+    (a) skewed retention — a zipf session mix (concurrent long-lived
+        traces, sizes 1..10k clipped to the pool) streamed to several
+        ring laps through BOTH layouts at EQUAL device memory; the
+        metric is complete-trace spans retained per device byte
+        (spans of traces the store still answers IN FULL), paged/ring
+        ratio — the acceptance gate is >= 2x;
+    (b) uniform ingest — contiguous fixed-size traces, serial and
+        pipelined spans/s for both layouts; the planner must cost
+        < 10% vs ring;
+    (c) skewed ingest rate through the paged planner, plus the
+        page-pool counters at end of stream."""
+    import numpy as np
+
+    import jax
+
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+
+    cap = 1 << max(12, total_spans.bit_length() - 3)
+    page_rows = 64
+    config = dev.StoreConfig(
+        capacity=cap, ann_capacity=4 * cap, bann_capacity=2 * cap,
+        max_services=64, max_span_names=256,
+        max_annotation_values=512, max_binary_keys=64,
+        cms_width=1 << 12, hll_p=10, quantile_buckets=512,
+        rank_path="counting",
+    )
+    cfg_paged = config._replace(layout="paged", page_rows=page_rows)
+    _log(f"paged phase: pool 2^{cap.bit_length() - 1} x2, "
+         f"{total_spans} spans, page_rows={page_rows}")
+    rng = np.random.default_rng(19)
+    eps = [Endpoint(1 + i, 80, f"psvc{i:02d}") for i in range(8)]
+    base = 1_700_000_000_000_000
+
+    # (a) the skewed session stream: SESSIONS concurrent long-lived
+    # traces (batch jobs dribbling spans), zipf-tailed sizes floored
+    # so every session's span SPREAD exceeds the ring window (~cap
+    # rows) while the total active footprint fits the page pool —
+    # plus a 5% stream of 1-span polls (the other end of the zipf).
+    # The FIFO ring holds a window full of session partials it can
+    # never answer whole; the paged store's trace-granular LRW keeps
+    # the active sessions complete at the same device memory.
+    SESSIONS = 16
+    lo, hi = cap // 10, min(10_000, cap // 5)
+    sizes = np.clip(rng.zipf(1.2, total_spans), lo, hi)
+    emitted: dict = {}
+    spans = []
+    next_tid = 1
+    next_size = iter(sizes.tolist())
+    active = []
+    for _ in range(SESSIONS):
+        active.append([next_tid, int(next(next_size)), 0])
+        next_tid += 1
+    churn = rng.random(total_spans) < 0.05
+    picks = rng.integers(0, SESSIONS, total_spans)
+    poll_tid = 1_000_000_000
+    for i in range(total_spans):
+        t0 = base + i * 10
+        if churn[i]:
+            ep = eps[poll_tid % 8]
+            spans.append(Span(poll_tid, "poll", poll_tid * 8 + 1, None,
+                              (Annotation(t0, "sr", ep),
+                               Annotation(t0 + 3, "ss", ep)), ()))
+            emitted[poll_tid] = 1
+            poll_tid += 1
+            continue
+        sess = active[int(picks[i])]
+        tid, size, done = sess
+        ep = eps[tid % 8]
+        spans.append(Span(tid, f"op{done % 8}", tid * 100_000 + done + 1,
+                          None, (Annotation(t0, "sr", ep),
+                                 Annotation(t0 + 7, "ss", ep)), ()))
+        emitted[tid] = done + 1
+        sess[2] = done + 1
+        if sess[2] >= size:
+            active[int(picks[i])] = [next_tid, int(next(next_size)), 0]
+            next_tid += 1
+    chunk = 512
+
+    def stream(store, pipelined=False):
+        if pipelined:
+            store.start_pipeline(8)
+        t0 = time.perf_counter()
+        for i in range(0, len(spans), chunk):
+            store.apply(spans[i:i + chunk])
+        if pipelined:
+            store.drain_pipeline()
+            store.stop_pipeline()
+        return time.perf_counter() - t0
+
+    def complete_spans(store) -> int:
+        """Spans belonging to traces the store still answers IN FULL
+        (count == every span emitted for that tid). Partial traces
+        credit zero — they are the skew tax."""
+        total = 0
+        tids = sorted(emitted)
+        for i in range(0, len(tids), 128):
+            batch = tids[i:i + 128]
+            for trace in store.get_spans_by_trace_ids(batch):
+                if not trace:
+                    continue
+                tid = trace[0].trace_id
+                if len(trace) == emitted[tid]:
+                    total += len(trace)
+        return total
+
+    ring = TpuSpanStore(config)
+    stream(ring)
+    paged = TpuSpanStore(cfg_paged)
+    skew_first_s = stream(paged)
+    state_bytes = int(sum(
+        a.size * a.dtype.itemsize
+        for a in jax.tree_util.tree_leaves(ring.state)))
+    ring_complete = complete_spans(ring)
+    paged_complete = complete_spans(paged)
+    pstats = paged.counters()
+    ring.close()
+
+    # (c) skewed ingest rate at warmed shapes.
+    steady = TpuSpanStore(cfg_paged)
+    skew_s = stream(steady)
+    steady.close()
+
+    # (b) uniform arm: contiguous 8-span traces, both layouts, serial
+    # and pipelined (the planner rides stage 1, overlapped with the
+    # device step exactly like encode).
+    uni = []
+    for t in range(total_spans // 8):
+        ep = eps[t % 8]
+        for j in range(8):
+            t0 = base + t * 100 + j
+            uni.append(Span(t + 1, f"uop{j}", t * 10 + j + 1, None,
+                            (Annotation(t0, "sr", ep),
+                             Annotation(t0 + 5, "ss", ep)), ()))
+
+    def udrive(cfg, pipelined):
+        store = TpuSpanStore(cfg)
+        if pipelined:
+            store.start_pipeline(8)
+        t0 = time.perf_counter()
+        for i in range(0, len(uni), chunk):
+            store.apply(uni[i:i + chunk])
+        if pipelined:
+            store.drain_pipeline()
+            store.stop_pipeline()
+        dt = time.perf_counter() - t0
+        store.close()
+        return len(uni) / dt
+
+    udrive(config, False)       # warm both lowerings
+    udrive(cfg_paged, False)
+    ring_uni = udrive(config, False)
+    paged_uni = udrive(cfg_paged, False)
+    udrive(config, True)
+    udrive(cfg_paged, True)
+    ring_uni_pipe = udrive(config, True)
+    paged_uni_pipe = udrive(cfg_paged, True)
+
+    out = {
+        "spans": len(spans),
+        "capacity": cap,
+        "page_rows": page_rows,
+        "sessions": SESSIONS,
+        "session_spans_min_max": [int(lo), int(hi)],
+        "ring_laps": round(len(spans) / cap, 1),
+        "state_bytes": state_bytes,
+        "ring_complete_spans": int(ring_complete),
+        "paged_complete_spans": int(paged_complete),
+        "ring_spans_per_mb": round(ring_complete * (1 << 20)
+                                   / state_bytes, 1),
+        "paged_spans_per_mb": round(paged_complete * (1 << 20)
+                                    / state_bytes, 1),
+        "retention_ratio": round(paged_complete
+                                 / max(1, ring_complete), 2),
+        "skewed_spans_per_s": round(len(spans) / skew_s, 1),
+        "skewed_first_drive_spans_per_s": round(
+            len(spans) / skew_first_s, 1),
+        "uniform_ring_spans_per_s": round(ring_uni, 1),
+        "uniform_paged_spans_per_s": round(paged_uni, 1),
+        "uniform_overhead_pct": round(
+            (ring_uni / paged_uni - 1.0) * 100.0, 2),
+        "uniform_pipelined_ring_spans_per_s": round(ring_uni_pipe, 1),
+        "uniform_pipelined_paged_spans_per_s": round(paged_uni_pipe, 1),
+        "pages_active": int(pstats["pages_active"]),
+        "pages_free": int(pstats["pages_free"]),
+        "page_reclaims_total": int(pstats["page_reclaims_total"]),
+    }
+    paged.close()
+    return out
+
+
 def bench_replication(total_spans: int = 100_000, n_replicas: int = 3):
     """Replication phase (r15 tentpole, zipkin_tpu.replicate): what
     WAL shipping buys and costs. One WAL-attached tiered primary
@@ -2040,6 +2243,16 @@ def main():
             timeout_s=900, label="windows")
         emit("stream+queries+exactness+archive+pipeline+durability"
              "+windows")
+        # Paged span layout (r19 tentpole, store/paged): complete-
+        # trace spans retained per device byte on a zipf session mix
+        # (the >=2x skew-tax acceptance arm) + the uniform-ingest
+        # planner overhead. Bounded like its neighbors.
+        detail["paged_layout"] = _bounded(
+            lambda: bench_paged(
+                int(2e4) if args.smoke else int(2e5)),
+            timeout_s=900, label="paged")
+        emit("stream+queries+exactness+archive+pipeline+durability"
+             "+windows+paged")
         # WAL-shipped replication (r15 tentpole, zipkin_tpu.replicate):
         # replica staleness lag under full ingest load, failover RTO,
         # aggregate sketch-tier queries/s across the device-free

@@ -818,6 +818,130 @@ def run_windows() -> dict:
     }
 
 
+def run_paged() -> dict:
+    """Paged-layout phase (r19 tentpole), tier-1 gates:
+
+    (a) census arithmetic — the paged fused-step lowering costs
+        EXACTLY the gated bump (census.expected_census("+PAGED"); the
+        ring lowering stays at BASE), so the layout can't silently
+        grow the step;
+    (b) ring-vs-paged BITWISE query parity on a skewed (zipf trace
+        size) stream — per-trace reads AND id lookups answer
+        identically through both layouts;
+    (c) zero steady-state recompiles driving the paged layout through
+        the ingest pipeline (same stream twice through warmed shapes);
+    (d) skewed-workload ingest rate through the paged planner (a
+        regression canary; the ≥2x retention-per-byte claim needs the
+        full bench's eviction arm — bench.py bench_paged)."""
+    import numpy as np
+
+    import jax  # noqa: F401 — device_get via stores below
+
+    from zipkin_tpu.models.span import Annotation, Endpoint, Span
+    from zipkin_tpu.store import census
+    from zipkin_tpu.store import device as dev
+    from zipkin_tpu.store.tpu import TpuSpanStore
+
+    cfg_ring = dev.StoreConfig(
+        capacity=1 << 10, ann_capacity=1 << 12, bann_capacity=1 << 11,
+        max_services=32, max_span_names=128, max_annotation_values=256,
+        max_binary_keys=64, cms_width=1 << 10, hll_p=8,
+        quantile_buckets=512, rank_path="counting",
+    )
+    cfg_paged = cfg_ring._replace(layout="paged", page_rows=128)
+
+    # Skewed stream: zipf trace sizes, 1-span polls to 64-span batch
+    # traces interleaved — the shape the paged layout exists for.
+    rng = np.random.default_rng(7)
+    eps = [Endpoint(1 + i, 80, f"psvc{i}") for i in range(4)]
+    base = 1_700_000_000_000_000
+    spans = []
+    tid = 1
+    while len(spans) < 700:
+        size = min(int(rng.zipf(1.6)), 64)
+        ep = eps[tid % 4]
+        for j in range(size):
+            t0 = base + tid * 1000 + j
+            spans.append(Span(
+                tid, f"pop{j % 4}", tid * 1000 + j + 1, None,
+                (Annotation(t0, "sr", ep),
+                 Annotation(t0 + 7, "ss", ep)), ()))
+        tid += 1
+    tids = list(range(1, tid))
+    end_ts = base + tid * 1000 + 10_000
+
+    def drive(store, pipelined=False):
+        if pipelined:
+            store.start_pipeline(4)
+        for i in range(0, len(spans), 200):
+            store.apply(spans[i:i + 200])
+        if pipelined:
+            store.drain_pipeline()
+            store.stop_pipeline()
+
+    ring = TpuSpanStore(cfg_ring)
+    drive(ring)
+    t0 = time.perf_counter()
+    paged = TpuSpanStore(cfg_paged)
+    drive(paged)
+    paged_first_s = time.perf_counter() - t0
+
+    # (b) bitwise parity: whole-trace reads and id lookups. One
+    # batched sweep covers every trace (one launch per store); the
+    # single-trace path is sampled — per-tid exhaustion lives in
+    # tests/test_paged.py's slow lane.
+    parity = (
+        ring.get_spans_by_trace_ids(tids)
+        == paged.get_spans_by_trace_ids(tids)) and all(
+        ring.get_spans_by_trace_ids([t]) ==
+        paged.get_spans_by_trace_ids([t])
+        for t in tids[::8])
+    key = lambda x: (x.trace_id, x.timestamp)  # noqa: E731
+    ids_parity = all(
+        sorted(ring.get_trace_ids_by_name(f"psvc{i}", None, end_ts,
+                                          200), key=key)
+        == sorted(paged.get_trace_ids_by_name(f"psvc{i}", None, end_ts,
+                                              200), key=key)
+        for i in range(4))
+
+    # (c) zero steady-state recompiles through the pipeline: warm the
+    # pipelined (device-staged) jit shapes by re-driving the already
+    # -compared paged store, then a FRESH store must compile nothing.
+    drive(paged, pipelined=True)
+    compiles0 = dev.compile_count()
+    steady = TpuSpanStore(cfg_paged)
+    t0 = time.perf_counter()
+    drive(steady, pipelined=True)
+    skew_s = time.perf_counter() - t0
+    recompiles = dev.compile_count() - compiles0
+
+    # (a) census arithmetic: paged-on vs ring lowering at the smoke
+    # shapes — exact equality against the lowering table rows.
+    census_on = steady.step_census(256, 1024, 512)
+    census_off = ring.step_census(256, 1024, 512)
+    es, eo, eg = census.expected_census("+PAGED")
+    bs, bo, bg = census.expected_census()
+
+    pstats = steady.counters()
+    for s in (ring, paged, steady):
+        s.close()
+    return {
+        "census_paged_on": census_on,
+        "census_paged_off": census_off,
+        "census_expected_on": {"scatter": es, "sort": eo, "gather": eg},
+        "census_expected_off": {"scatter": bs, "sort": bo,
+                                "gather": bg},
+        "query_parity_bitwise": bool(parity),
+        "ids_parity_bitwise": bool(ids_parity),
+        "recompiles_steady_state": int(recompiles),
+        "skewed_spans_per_s": round(len(spans) / skew_s, 1),
+        "first_drive_s": round(paged_first_s, 2),
+        "pages_active": int(pstats["pages_active"]),
+        "pages_free": int(pstats["pages_free"]),
+        "page_reclaims_total": int(pstats["page_reclaims_total"]),
+    }
+
+
 def run_replication() -> dict:
     """WAL-shipped replication phase (r15 tentpole), proven
     structurally on every CI run: (a) a device-free ReplicaSpanStore
@@ -1539,6 +1663,7 @@ def run(total_spans: int = 7000, k_queries: int = 8) -> dict:
         "query": run_query(),
         "ingest_structure": run_ingest_structure(),
         "windows": run_windows(),
+        "paged": run_paged(),
         "replication": run_replication(),
         "sharded": run_sharded(),
         "fleet_obs": run_fleet_obs(),

@@ -143,6 +143,18 @@ _SLOW_TESTS = {
     "test_tcp_follow_and_anchor_bootstrap",
     "test_replica_retention_drops_old_segments",
     "test_standby_follow_promote_bitwise",
+    # Paged-layout deep coverage (tests/test_paged.py): tier-1 keeps
+    # the SPI conformance sweep, the Pallas/XLA bitwise gate, planner
+    # geometry guards, the reclaim fuzz, rev-18 + pre-18 checkpoint
+    # compat, and WAL-replay bitwise; bench_smoke's paged phase gates
+    # census arithmetic, ring-vs-paged bitwise parity and the
+    # zero-recompile bound every tier-1 run, so the long skewed-stream
+    # parity drive, the tiered eviction/capture drive, the mirror
+    # sweep, and the counting-rank census build ride here.
+    "test_query_parity_vs_ring_skewed_stream",
+    "test_tiered_parity_through_eviction_and_capture",
+    "test_mirror_is_layout_independent",
+    "test_paged_counters_and_census_budget",
 }
 
 

@@ -21,9 +21,8 @@ from zipkin_tpu.store.census import (
     BASE_STEP_SCATTERS,
     BASE_STEP_SORTS,
     MAX_MIRROR_DELTA_RATIO,
-    MAX_STEP_GATHERS,
-    MAX_STEP_SCATTERS,
     MAX_STEP_SORTS,
+    expected_census,
 )
 
 
@@ -170,9 +169,9 @@ def test_bench_smoke_json_and_op_ceilings():
     # sketch-tier windowed quantile answers inside the documented
     # solver rank tolerance with sub-10ms host-only latency.
     w = rec["windows"]
+    ws, wo, wg = expected_census("+WINDOW")
     assert w["census_window_on"] == {
-        "scatter": MAX_STEP_SCATTERS, "sort": MAX_STEP_SORTS,
-        "gather": MAX_STEP_GATHERS,
+        "scatter": ws, "sort": wo, "gather": wg,
     }, w
     assert w["census_window_off"] == {
         "scatter": BASE_STEP_SCATTERS, "sort": BASE_STEP_SORTS,
@@ -186,6 +185,29 @@ def test_bench_smoke_json_and_op_ceilings():
     assert w["burn_errors"] >= 1, w
     assert w["heatmap_columns"] >= 1, w
     assert w["window_spans_folded"] > 0, w
+    # Paged-layout phase (r19 tentpole): the paged fused-step lowering
+    # must cost EXACTLY the gated census bump (the ring lowering stays
+    # at BASE), queries through the paged layout must answer BITWISE
+    # identical to a ring store fed the same skewed stream (whole-trace
+    # reads and id lookups), and re-driving warmed shapes through the
+    # ingest pipeline must perform ZERO recompiles (page claims are
+    # host-side planner work; pad buckets alone pick compiled
+    # variants). The ≥2x retention-per-byte acceptance arm lives in
+    # bench.py's bench_paged phase (needs the full eviction sweep).
+    ps, po, pg = expected_census("+PAGED")
+    bs2, bo2, bg2 = expected_census()
+    pg_rec = rec["paged"]
+    assert pg_rec["census_paged_on"] == {
+        "scatter": ps, "sort": po, "gather": pg,
+    }, pg_rec
+    assert pg_rec["census_paged_off"] == {
+        "scatter": bs2, "sort": bo2, "gather": bg2,
+    }, pg_rec
+    assert pg_rec["query_parity_bitwise"] is True, pg_rec
+    assert pg_rec["ids_parity_bitwise"] is True, pg_rec
+    assert pg_rec["recompiles_steady_state"] == 0, pg_rec
+    assert pg_rec["skewed_spans_per_s"] > 0, pg_rec
+    assert pg_rec["pages_active"] >= 1, pg_rec
     # Replication phase (r15 tentpole): a device-free ReplicaSpanStore
     # fed only shipped WAL records over the real framed-TCP ship path
     # must answer the sketch tier and row reads BITWISE identical to

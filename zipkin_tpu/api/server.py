@@ -220,8 +220,9 @@ class ApiServer:
         # state; changing it means a new store.
         def _static(_v):
             raise QueryException(
-                "window geometry is static store state "
-                "(restart with --window-seconds/--window-buckets)")
+                "static store state (window geometry / span-plane "
+                "layout shape device arrays; restart with the "
+                "matching flag to change them)")
 
         backing = getattr(query.store, "hot", query.store)
         store_cfg = getattr(backing, "config", None)
@@ -231,6 +232,14 @@ class ApiServer:
                 lambda: store_cfg.window_seconds, _static)
             self.vars["windowBuckets"] = (
                 lambda: store_cfg.window_buckets, _static)
+        # Span-plane layout echo (the daemon's --layout/--page-rows):
+        # READ-ONLY like the window geometry — the layout shapes the
+        # device planes and the page planner; changing it means a new
+        # store (rebuild via checkpoint restore, docs/MIGRATION.md).
+        if store_cfg is not None and hasattr(store_cfg, "layout"):
+            self.vars["layout"] = (lambda: store_cfg.layout, _static)
+            self.vars["pageRows"] = (
+                lambda: store_cfg.page_rows, _static)
         elif hasattr(backing, "window_seconds"):
             # Scan backends (memory store): bucket width only — the
             # exact scan has no ring, so no windowBuckets to echo.

@@ -82,7 +82,21 @@ _PINS_FILE = "pins.pkl"
 #    sketch-mirror cold resync below already re-adopts the window
 #    twins with the other aggregates. Pre-14 loaders drop the unknown
 #    leaves via the `known` filter.
-_REVISION = 14
+# 18: paged span layout (store/paged): snapshots of a paged store add
+#    meta["paged"] — the host page allocator + per-trace page-table
+#    snapshot, including the recent claim-plan memo keyed by WAL seq
+#    (the pipelined-save window: units planned ahead of the gathered
+#    device frontier replay from recorded claims, not re-planning).
+#    The StoreState leaf schema is UNCHANGED — the paged layout reuses
+#    the ring arenas with epoch-encoded gids — so pre-18 ring
+#    snapshots restore exactly as before (StoreConfig defaults fill
+#    layout="ring"), and a paged store restoring a snapshot WITHOUT
+#    the key rebuilds its page table from the resident row_gid /
+#    trace_id columns (PagePlanner.rebuild; partial pages stay
+#    closed). Revisions 15-17 were consumed by the replication /
+#    sharded-serving line (sharded clocks, fleet WAL shipping); their
+#    snapshots restore through the same revision-tolerant key checks.
+_REVISION = 18
 _SEGMENTS_DIR = "segments"
 
 
@@ -451,6 +465,13 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
                     allow_pickle=False)
     archive_meta = None
     seg_blobs = []
+    # Paged layout (revision 18): snapshot the page allocator + page
+    # table. plan_unit keys each claim plan to its WAL seq atomically
+    # under the planner lock, so this cut is self-consistent at ANY
+    # boundary: plans at seq <= the snapshot's last_seq replay from
+    # the recorded memo; later ones re-derive deterministically.
+    planner = getattr(store, "_planner", None)
+    paged_meta = planner.snapshot() if planner is not None else None
     with store._lock:
         # Pinned traces' eviction-exempt banks must survive restarts —
         # the TTL alone restoring while the spans vanish would break the
@@ -523,6 +544,8 @@ def save(store, path: str, chunk_deadline_s: Optional[float] = None,
         meta["archive"] = archive_meta
     if clocks is not None:
         meta["clocks"] = clocks
+    if paged_meta is not None:
+        meta["paged"] = paged_meta
     parent = os.path.dirname(os.path.abspath(path)) or "."
     tmp = tempfile.mkdtemp(prefix=".ckpt-", dir=parent)
     old = path + ".old"
@@ -962,6 +985,19 @@ def load(path: str, mesh=None, config_defaults=None):
             store._cap_b = int(clocks["cap_b"])
             store._sealed_upto = int(clocks["sealed_upto"])
         store._wal_applied = int(clocks.get("wal_applied", 0))
+    # Paged layout (revision 18): restore the page allocator + page
+    # table — or, for a paged config pointed at a snapshot saved
+    # without it (pre-18, or a ring store's), rebuild the table from
+    # the resident device columns.
+    if getattr(store, "_planner", None) is not None:
+        pmeta = meta.get("paged")
+        if pmeta:
+            store._planner.restore(pmeta)
+        else:
+            row_gid, trace_col = jax.device_get(
+                (store.state.row_gid, store.state.trace_id))
+            store._planner.rebuild(row_gid, trace_col,
+                                   wal_applied=store._wal_applied)
     arch = meta.get("archive")
     if arch:
         return _restore_tiered(path, store, arch,

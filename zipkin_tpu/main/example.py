@@ -33,6 +33,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "to simulate")
     p.add_argument("--capacity", type=int, default=1 << 16,
                    help="span ring capacity (device store)")
+    p.add_argument("--layout", default="ring",
+                   choices=("ring", "paged"),
+                   help="span-plane layout: 'ring' = the FIFO ring "
+                        "(default); 'paged' = fixed-size device pages "
+                        "with per-trace chaining and LRW page reclaim, "
+                        "so one hot 10k-span trace can't evict a "
+                        "thousand cold 1-span traces "
+                        "(docs/STORAGE_TIERS.md; single-device stores "
+                        "only; echoed at /vars/layout)")
+    p.add_argument("--page-rows", type=int, default=128,
+                   help="rows per page for --layout paged (power of "
+                        "two dividing --capacity; 128 keeps the "
+                        "Pallas gather lane-aligned — echoed at "
+                        "/vars/pageRows)")
     p.add_argument("--batch-spans", type=int, default=0,
                    help="ingest batch escalation: max spans per device "
                         "launch (0 = the store's legacy 4096 default; "
@@ -187,6 +201,21 @@ def build_app(args):
             "--checkpoint requires a device store (the in-memory "
             "reference store has no snapshot support)"
         )
+    if args.layout != "ring":
+        # The paged planner is per-store host state; the sharded
+        # store's stacked states have no per-shard planner yet, and
+        # the memory store has no device layout at all.
+        if args.memory_store:
+            raise SystemExit(
+                "--layout paged requires a device store (the "
+                "in-memory reference store has no span planes)"
+            )
+        if args.shards:
+            raise SystemExit(
+                "--layout paged requires the single-device store "
+                "(the sharded store's per-shard page planner is not "
+                "wired yet)"
+            )
     store = None
     if args.checkpoint:
         from zipkin_tpu import checkpoint
@@ -266,6 +295,8 @@ def build_app(args):
                 rank_path=args.rank_path,
                 window_seconds=args.window_seconds,
                 window_buckets=args.window_buckets,
+                layout=args.layout,
+                page_rows=args.page_rows,
             ))
     if args.cold_tier:
         if hasattr(store, "archive"):

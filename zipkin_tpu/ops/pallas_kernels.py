@@ -306,6 +306,83 @@ def arena_claim_scatter(entries, bucket, base, slot0, depth, vals,
         jnp.moveaxis(flat, 0, 1).reshape(S, 3, 2), jnp.int64)
 
 
+# ---------------------------------------------------------------------------
+# Paged trace-assembly block gather (r19)
+# ---------------------------------------------------------------------------
+
+# VMEM model for the page gather: the kernel streams one (W, R) i32
+# page block per grid step (double-buffered in/out DMA), so residency
+# is a handful of blocks, not the pool — but keep an explicit ceiling
+# so absurd page_rows (or a plane count change) degrade to the XLA
+# take fallback instead of a Mosaic allocation failure, mirroring the
+# arena_claim_scatter gate.
+PAGED_GATHER_VMEM_BUDGET = 10 << 20
+
+
+def paged_gather_supported(capacity: int, page_rows: int,
+                           n_cols: int, n_pages_req: int) -> bool:
+    """True when the paged trace gather may take the Pallas block
+    kernel. Lane alignment: the (W, page_rows) block's last dim must be
+    a multiple of 128 and the plane matrix [W, capacity] must tile
+    evenly into page blocks. VMEM: ~4 in+out blocks resident
+    (double-buffered DMA) under the ceiling."""
+    W = 2 * n_cols
+    if page_rows % LANES != 0 or capacity % page_rows != 0:
+        return False
+    if n_pages_req <= 0:
+        return False
+    return 4 * W * page_rows * 4 <= PAGED_GATHER_VMEM_BUDGET
+
+
+def _paged_gather_kernel(pages_ref, in_ref, out_ref):
+    # One grid step per requested page: the scalar-prefetched page list
+    # drives the INPUT block index map (a block-level gather — no
+    # in-kernel dynamic slicing, so no Mosaic divisibility proofs
+    # beyond the lane-aligned block shape), and the body just forwards
+    # the block. Holes (-1 pages, the pad) are clamped to block 0 by
+    # the index map and zero-filled here so both gather paths mask
+    # identically downstream.
+    i = pl.program_id(0)
+
+    @pl.when(pages_ref[i] < 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    @pl.when(pages_ref[i] >= 0)
+    def _():
+        out_ref[...] = in_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("page_rows",))
+def paged_page_gather(planes, pages, page_rows: int):
+    """Gather page blocks out of the plane matrix.
+
+    ``planes`` [W, capacity] i32 — the span columns as lo/hi bit-planes
+    (W = 2 * n_cols, built by the caller with one free bitcast);
+    ``pages`` [K] i32 page ids, -1 for holes. Returns [W, K *
+    page_rows] i32: output block i is page ``pages[i]``'s rows (zeros
+    for holes). The W axis rides the "second-to-last dim equals the
+    array dim" Mosaic block rule, so any lane-aligned page_rows works.
+    Callers check ``paged_gather_supported`` first."""
+    W, _ = planes.shape
+    K = pages.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(K,),
+        in_specs=[pl.BlockSpec(
+            (W, page_rows),
+            lambda i, pages: (i - i, jnp.maximum(pages[i], 0)),
+        )],
+        out_specs=pl.BlockSpec((W, page_rows), lambda i, pages: (i - i, i)),
+    )
+    return pl.pallas_call(
+        _paged_gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((W, K * page_rows), jnp.int32),
+        interpret=_interpret(),
+    )(jnp.asarray(pages, jnp.int32), planes)
+
+
 def scatter_histogram_xla(counts, idx, weights=None):
     """XLA reference path (what store/device.py uses today); kept for
     benchmarking the pallas kernel against on real hardware."""

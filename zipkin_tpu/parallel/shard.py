@@ -178,6 +178,13 @@ class ShardedStore:
     """
 
     def __init__(self, mesh: Mesh, config: dev.StoreConfig, axis: str = "shard"):
+        if config.paged_enabled:
+            # The page planner is per-store HOST state; the stacked
+            # per-shard states have no per-shard planner yet (the
+            # daemon rejects --layout paged with --shards too).
+            raise ValueError(
+                "layout='paged' is single-device only; the sharded "
+                "store has no per-shard page planner yet")
         self.mesh = mesh
         self.axis = axis
         self.config = config

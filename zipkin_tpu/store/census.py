@@ -9,6 +9,13 @@ the tier-1 test, the notes); a path change now updates exactly one
 number here, consumed by ``scripts/bench_smoke.py`` and
 ``tests/test_bench_smoke.py``.
 
+r19 restructures the constants into a LOWERING TABLE: the base counts
+plus one explicit gated bump per optional layout/feature, so a new
+layout cannot ride ungated — adding one REQUIRES adding its ``+NAME``
+row here, and ``expected_census`` composes any feature combination
+(bench_smoke's windows and paged phases both gate at exact equality
+against the composed row).
+
 History of the measured counts at the smoke shapes:
 
 - r5 split index design: 101 scatters / 6 sorts / 80 gathers;
@@ -32,28 +39,64 @@ History of the measured counts at the smoke shapes:
   the daemon turns it on via ``--window-seconds``), so the BASE
   lowering stays 95/4/79 and the window-on lowering sits exactly at
   BASE + WINDOW_BUMP (bench_smoke's windows phase gates both).
+- r19 paged layout:       +2 scatters / +0 sorts / +2 gathers —
+  ``layout="paged"`` (store/paged): the reclaimed-page row_gid
+  invalidation is ONE i64 ring write (= 2 i32 plane scatters through
+  the same _uset discipline as every other plane pair), and the
+  side-ring index segments gather their owning span's planner gid
+  from the batch column (+1 gather each for ann/bann) instead of
+  deriving it from write_pos arithmetic. Slot/gid assignment itself
+  moves HOST-side into the page planner, so the step spends nothing
+  on allocation. Additive with the window bump (measured: paged+win
+  == BASE + WINDOW + PAGED exactly).
 
 Raise a ceiling only with a note here explaining what bought the
 extra launches.
 """
 
+# The per-layout lowering table: (scatters, sorts, gathers) — "BASE"
+# is the default ring/window-off lowering; every "+NAME" row is the
+# explicit gated bump one optional feature may spend inside the fused
+# step. New layouts MUST add a row (test_bench_smoke gates the table's
+# composed rows at exact equality, so an ungated path shows up as a
+# census mismatch, not a silent regression).
+LOWERING_TABLE = {
+    "BASE": (95, 4, 79),
+    "+WINDOW": (5, 0, 2),   # r13 windowed Moments-sketch arena
+    "+PAGED": (2, 0, 2),    # r19 paged span layout
+}
+
+
+def expected_census(*bumps: str):
+    """(scatters, sorts, gathers) ceiling for BASE plus the named
+    bumps, e.g. ``expected_census("+WINDOW", "+PAGED")``. Unknown bump
+    names raise — the "can't ride ungated" contract."""
+    s, o, g = LOWERING_TABLE["BASE"]
+    for b in bumps:
+        if b == "BASE":
+            continue
+        bs, bo, bg = LOWERING_TABLE[b]
+        s, o, g = s + bs, o + bo, g + bg
+    return s, o, g
+
+
 # Fused-step BASE ceilings: the default (window-off) lowering, gated
 # in tier-1 against the main smoke stream (tests/test_bench_smoke.py).
-BASE_STEP_SCATTERS = 95
-BASE_STEP_SORTS = 4
-BASE_STEP_GATHERS = 79
+BASE_STEP_SCATTERS, BASE_STEP_SORTS, BASE_STEP_GATHERS = (
+    LOWERING_TABLE["BASE"])
 
 # The r13 windowed-arena bump (window_seconds > 0): the gated extra
 # launches the feature is allowed to spend inside the fused step.
-WINDOW_BUMP_SCATTERS = 5
-WINDOW_BUMP_GATHERS = 2
+WINDOW_BUMP_SCATTERS, _, WINDOW_BUMP_GATHERS = LOWERING_TABLE["+WINDOW"]
 
-# Overall ceilings — the window-on lowering (every optional path
-# engaged); bench_smoke's windows phase gates the on-lowering at
-# EXACTLY these counts.
-MAX_STEP_SCATTERS = BASE_STEP_SCATTERS + WINDOW_BUMP_SCATTERS
-MAX_STEP_SORTS = BASE_STEP_SORTS
-MAX_STEP_GATHERS = BASE_STEP_GATHERS + WINDOW_BUMP_GATHERS
+# The r19 paged-layout bump (layout="paged"): see the history note.
+PAGED_BUMP_SCATTERS, _, PAGED_BUMP_GATHERS = LOWERING_TABLE["+PAGED"]
+
+# Overall ceilings — every optional path engaged (window + paged);
+# bench_smoke's feature phases gate each on-lowering at EXACTLY its
+# composed table row, so these are pure upper bounds for coarse gates.
+MAX_STEP_SCATTERS, MAX_STEP_SORTS, MAX_STEP_GATHERS = expected_census(
+    "+WINDOW", "+PAGED")
 
 # The argsort rank path's sort count — the pre-r12 ceiling, still the
 # expected lowering when rank_path="argsort" (or the wm_shift == 0 /

@@ -151,6 +151,34 @@ class StoreConfig(NamedTuple):
     # store/census.py (BASE vs BASE + WINDOW_BUMP lowerings).
     window_seconds: int = 0
     window_buckets: int = 64
+    # Paged span storage (r19, the Ragged-Paged-Attention layout): the
+    # span ring is carved into capacity/page_rows fixed-size pages
+    # allocated from a host free-list (store/paged.PagePlanner) and
+    # chained per trace, so wildly skewed trace sizes share one slot
+    # pool without over-provisioning. gids stay epoch-encoded
+    # (gid = page_epoch * capacity + slot), which keeps the
+    # slot == gid % capacity liveness invariant — every ring-scan query
+    # kernel works unchanged on a paged store. "ring" (default) is the
+    # historical FIFO layout; its fused-step lowering is byte-identical
+    # with these fields present (static branch, store/census.py BASE).
+    layout: str = "ring"
+    # Rows per device page. Power of two >= 8; multiples of 128 keep
+    # the pallas page-gather kernel eligible (lane-aligned sublane
+    # slices — see ops/pallas_kernels.paged_gather_supported).
+    page_rows: int = 256
+    # Host page-table chain bound per trace: a trace spanning more
+    # pages than this stops being page-addressable and its reads fall
+    # back to the exact ring-scan gather (bounded host memory; the
+    # maxTraceCols-style guard at page granularity).
+    page_max_chain: int = 64
+
+    @property
+    def paged_enabled(self) -> bool:
+        return self.layout == "paged"
+
+    @property
+    def n_pages(self) -> int:
+        return self.capacity // max(1, self.page_rows)
 
     @property
     def tab_slots(self) -> int:
@@ -1072,6 +1100,16 @@ class DeviceBatch(NamedTuple):
     # False for direct-device callers that don't track errors.
     error_flag: jnp.ndarray
 
+    # Paged layout (r19) stage-1 page claims, planned on the HOST by
+    # store/paged.PagePlanner (deterministic from the unit stream, so
+    # WAL replay re-derives bitwise-identical claims). Ring batches
+    # carry shape-(1,) placeholders; the ring lowering never touches
+    # them (static branch → DCE, same discipline as error_flag before
+    # the window arena existed).
+    span_slot: jnp.ndarray      # i32 [P]  destination slot per span
+    span_gid: jnp.ndarray       # i64 [P]  epoch-encoded gid per span
+    reclaim_page: jnp.ndarray   # i32 [RC] page ids invalidated first (-1 pad)
+
 
 def _pad(a: np.ndarray, n: int, fill=0, dtype=None) -> np.ndarray:
     dtype = dtype or a.dtype
@@ -1088,6 +1126,10 @@ def make_device_batch(
     pad_anns: int,
     pad_banns: int,
     error_flag: np.ndarray = None,
+    span_slot: np.ndarray = None,
+    span_gid: np.ndarray = None,
+    reclaim_pages: np.ndarray = None,
+    pad_reclaims: int = 1,
 ) -> DeviceBatch:
     """Host: pad a SpanBatch (+ index columns) to static shapes.
 
@@ -1140,6 +1182,23 @@ def make_device_batch(
             np.zeros(batch.n_spans, bool) if error_flag is None
             else np.asarray(error_flag, bool),
             pad_spans, False,
+        ),
+        # Ring batches keep shape-(1,) placeholders so every ring unit
+        # shares one jit cache entry; paged batches pad the planner's
+        # claims to the unit's static shapes.
+        span_slot=(
+            np.zeros(1, np.int32) if span_slot is None
+            else _pad(np.asarray(span_slot, np.int32), pad_spans)
+        ),
+        span_gid=(
+            np.zeros(1, np.int64) if span_gid is None
+            else _pad(np.asarray(span_gid, np.int64), pad_spans, -1)
+        ),
+        reclaim_page=(
+            np.full(1, -1, np.int32) if reclaim_pages is None
+            else _pad(
+                np.asarray(reclaim_pages, np.int32), pad_reclaims, -1
+            )
         ),
     )
 
@@ -2203,12 +2262,42 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     mask_a = jnp.arange(PA) < b.n_anns
     mask_b = jnp.arange(PB) < b.n_banns
 
-    # -- span ring writes ----------------------------------------------
-    # Consecutive slots mod capacity are unique within a batch
-    # (P <= capacity, enforced by the host chunkers), so every ring
-    # column write rides the fast unique plane scatter (_uset).
-    gids = state.write_pos + jnp.arange(P, dtype=jnp.int64)
-    slots = (gids % c.capacity).astype(jnp.int32)
+    # -- span ring/page writes -----------------------------------------
+    # Ring: consecutive slots mod capacity are unique within a batch
+    # (P <= capacity, enforced by the host chunkers). Paged (r19): the
+    # host PagePlanner pre-assigned each span a (slot, epoch-encoded
+    # gid) pair with gid = page_epoch * capacity + slot — slots are
+    # unique among valid rows by construction (pages fill
+    # monotonically, pages are distinct), and slot == gid % capacity
+    # still holds, so every liveness check downstream is layout-blind.
+    # Either way the column writes ride the fast unique plane scatter
+    # (_uset).
+    if c.paged_enabled:
+        R = c.page_rows
+        RC = b.reclaim_page.shape[0]
+        # Invalidate every row of the pages this unit reclaims BEFORE
+        # the batch writes land (the functional update chain fixes the
+        # order): the planner spliced these pages out of their owners'
+        # chains, and a stale row_gid would keep the old spans visible
+        # to the ring-scan kernels but not the page gather. The
+        # reclaimed rows were captured host-side before this launch
+        # (TpuSpanStore._capture_pages), so the captured-before-
+        # overwrite invariant holds per page.
+        r_slots = (
+            b.reclaim_page[:, None] * R
+            + jnp.arange(R, dtype=jnp.int32)[None, :]
+        ).reshape(-1)
+        r_ok = jnp.repeat(b.reclaim_page >= 0, R)
+        row_gid0 = _uset(
+            state.row_gid, r_slots, jnp.full(RC * R, -1, jnp.int64),
+            r_ok,
+        )
+        gids = b.span_gid
+        slots = b.span_slot
+    else:
+        row_gid0 = state.row_gid
+        gids = state.write_pos + jnp.arange(P, dtype=jnp.int64)
+        slots = (gids % c.capacity).astype(jnp.int32)
     upd = {}
     for col in (
         "trace_id", "span_id", "parent_id", "name_id", "name_lc_id",
@@ -2217,13 +2306,20 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
     ):
         upd[col] = _uset(getattr(state, col), slots, getattr(b, col),
                          mask)
-    upd["row_gid"] = _uset(state.row_gid, slots, gids, mask)
+    upd["row_gid"] = _uset(row_gid0, slots, gids, mask)
     upd["write_pos"] = state.write_pos + b.n_spans.astype(jnp.int64)
 
     # -- annotation ring writes ----------------------------------------
+    # Annotation/binary rings stay FIFO under BOTH layouts (ann rows
+    # have no pages; their liveness rides the owning span's gid via
+    # _span_slot), so ring-age ordering and the _iq freshness gates
+    # keep working unchanged in paged mode.
     a_gids = state.ann_write_pos + jnp.arange(PA, dtype=jnp.int64)
     a_slots = (a_gids % c.ann_capacity).astype(jnp.int32)
-    span_gid_of_ann = state.write_pos + b.ann_span_idx.astype(jnp.int64)
+    if c.paged_enabled:
+        span_gid_of_ann = gids[b.ann_span_idx]
+    else:
+        span_gid_of_ann = state.write_pos + b.ann_span_idx.astype(jnp.int64)
     upd["ann_gid"] = _uset(
         state.ann_gid, a_slots, jnp.where(mask_a, span_gid_of_ann, -1),
         mask_a,
@@ -2235,7 +2331,10 @@ def ingest_step(state: StoreState, b: DeviceBatch) -> StoreState:
 
     bb_gids = state.bann_write_pos + jnp.arange(PB, dtype=jnp.int64)
     bb_slots = (bb_gids % c.bann_capacity).astype(jnp.int32)
-    span_gid_of_bann = state.write_pos + b.bann_span_idx.astype(jnp.int64)
+    if c.paged_enabled:
+        span_gid_of_bann = gids[b.bann_span_idx]
+    else:
+        span_gid_of_bann = state.write_pos + b.bann_span_idx.astype(jnp.int64)
     upd["bann_gid"] = _uset(
         state.bann_gid, bb_slots,
         jnp.where(mask_b, span_gid_of_bann, -1), mask_b,
@@ -3294,12 +3393,13 @@ BANN_MAT_COLS = ("bann_gid", "bann_key_id", "bann_value_id", "bann_type",
                  "bann_service_id", "bann_endpoint_id")
 
 
-@partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12))
+@partial(jax.jit, static_argnums=(7, 8, 9, 10, 11, 12, 13))
 def _gather_impl(
     span_cols, ann_cols, bann_cols, sorted_qids,
     write_pos, ann_write_pos, bann_write_pos,
     capacity: int, ann_capacity: int, bann_capacity: int,
     k_spans: int, k_anns: int, k_banns: int,
+    paged: bool = False,
 ):
     trace_id = span_cols[0]
     row_gid = span_cols[-1]
@@ -3328,7 +3428,15 @@ def _gather_impl(
         _, sel = jax.lax.top_k(key, k)
         return sel
 
-    sel = oldest_k(span_in, write_pos, capacity, k_spans)
+    if paged:
+        # Paged layout: slot position is a page assignment, not an
+        # arrival rank — insertion order lives in the epoch-encoded
+        # gid, so span rows sort by the i64 gid key directly (the
+        # _iq_gather_impl idiom).
+        skey = jnp.where(span_in, I64_MAX - row_gid, jnp.int64(-1))
+        _, sel = jax.lax.top_k(skey, k_spans)
+    else:
+        sel = oldest_k(span_in, write_pos, capacity, k_spans)
     span_mat = jnp.stack([c[sel].astype(jnp.int64) for c in span_cols])
 
     a_sel = oldest_k(ann_in, ann_write_pos, ann_capacity, k_anns)
@@ -3348,12 +3456,13 @@ def _gather_impl(
     return counts, span_mat, ann_mat, bann_mat
 
 
-@partial(jax.jit, static_argnums=(8, 9, 10, 11, 12, 13))
+@partial(jax.jit, static_argnums=(8, 9, 10, 11, 12, 13, 14))
 def _capture_impl(
     span_cols, ann_cols, bann_cols, lo, hi,
     write_pos, ann_write_pos, bann_write_pos,
     capacity: int, ann_capacity: int, bann_capacity: int,
     k_spans: int, k_anns: int, k_banns: int,
+    paged: bool = False,
 ):
     row_gid = span_cols[-1]
     ann_gid = ann_cols[0]
@@ -3370,7 +3479,14 @@ def _capture_impl(
         _, sel = jax.lax.top_k(key, k)
         return sel
 
-    sel = oldest_k(span_in, write_pos, capacity, k_spans)
+    if paged:
+        # Page-granular capture: order the page's spans by gid (their
+        # insertion order) so the sealed segment is bitwise-stable
+        # regardless of slot placement inside the page.
+        skey = jnp.where(span_in, I64_MAX - row_gid, jnp.int64(-1))
+        _, sel = jax.lax.top_k(skey, k_spans)
+    else:
+        sel = oldest_k(span_in, write_pos, capacity, k_spans)
     span_mat = jnp.stack([c[sel].astype(jnp.int64) for c in span_cols])
     a_sel = oldest_k(ann_in, ann_write_pos, ann_capacity, k_anns)
     ann_mat = jnp.stack([c[a_sel].astype(jnp.int64) for c in ann_cols])
@@ -3412,7 +3528,7 @@ def capture_eviction_rows(
         jnp.int64(lo), jnp.int64(hi),
         state.write_pos, state.ann_write_pos, state.bann_write_pos,
         c.capacity, c.ann_capacity, c.bann_capacity,
-        k_spans, k_anns, k_banns,
+        k_spans, k_anns, k_banns, c.paged_enabled,
     )
 
 
@@ -3441,9 +3557,132 @@ def gather_trace_rows(
         sorted_qids,
         state.write_pos, state.ann_write_pos, state.bann_write_pos,
         c.capacity, c.ann_capacity, c.bann_capacity,
-        k_spans, k_anns, k_banns,
+        k_spans, k_anns, k_banns, c.paged_enabled,
     )
 
+
+@partial(jax.jit, static_argnums=(8, 9, 10, 11, 12, 13, 14, 15))
+def _paged_gather_impl(
+    span_cols, ann_cols, bann_cols, sorted_qids, pages, epochs,
+    ann_write_pos, bann_write_pos,
+    capacity: int, page_rows: int, ann_capacity: int, bann_capacity: int,
+    k_spans: int, k_anns: int, k_banns: int, pallas: bool,
+):
+    """Paged trace assembly (r19): gather span rows from an explicit
+    page list instead of scanning the whole ring.
+
+    ``pages`` [K] i32 / ``epochs`` [K] i64 come from the host page
+    table (store/paged.PagePlanner.chains_for) — every page any queried
+    trace has rows in, -1-padded. Validity is per ROW, not per page:
+    the expected gid of slot (p, j) is epoch*capacity + p*R + j, and a
+    gathered row counts only when its live row_gid equals that AND its
+    trace_id is one of ``sorted_qids`` (pages are shared by small
+    traces, so a page may carry rows of non-queried traces). Both
+    gather paths — the Pallas block-gather kernel and the XLA take
+    fallback — feed the same mask, and the output span_mat is masked to
+    -1 on dead rows, so the two are bitwise identical
+    (tests/test_paged.py gates it).
+
+    Annotation/binary rows stay on their FIFO rings (no pages), so
+    their membership is the _gather_impl scan unchanged.
+    """
+    trace_col = span_cols[0]
+    row_gid = span_cols[-1]
+    ann_gid = ann_cols[0]
+    bann_gid = bann_cols[0]
+    nq = sorted_qids.shape[0]
+    R = page_rows
+    n_pages = capacity // R
+    pg = jnp.clip(pages, 0, n_pages - 1)
+    offs = jnp.arange(R, dtype=jnp.int32)[None, :]
+    page_slots = pg[:, None] * R + offs                      # [K, R]
+    expected = jnp.where(
+        pages[:, None] >= 0,
+        epochs[:, None] * jnp.int64(capacity)
+        + page_slots.astype(jnp.int64),
+        jnp.int64(-1),
+    ).reshape(-1)                                            # [K*R]
+    ncols = len(span_cols)
+    if pallas:
+        from zipkin_tpu.ops import pallas_kernels as PK
+
+        cols64 = jnp.stack([col.astype(jnp.int64) for col in span_cols])
+        planes = jnp.moveaxis(_p32(cols64), 2, 1).reshape(
+            2 * ncols, capacity)
+        out = PK.paged_page_gather(planes, pages, R)         # [2C, K*R]
+        rows = _p64(jnp.moveaxis(out.reshape(ncols, 2, -1), 1, 2))
+    else:
+        slot = page_slots.reshape(-1)
+        rows = jnp.stack(
+            [col[slot].astype(jnp.int64) for col in span_cols])
+    g_tid = rows[0]
+    g_gid = rows[-1]
+    g_live = (expected >= 0) & (g_gid == expected)
+    g_pos = jnp.clip(jnp.searchsorted(sorted_qids, g_tid), 0, nq - 1)
+    ok = g_live & (sorted_qids[g_pos] == g_tid)
+    skey = jnp.where(ok, I64_MAX - expected, jnp.int64(-1))
+    _, sel = jax.lax.top_k(skey, k_spans)
+    span_mat = jnp.where(ok[sel][None, :], rows[:, sel], -1)
+
+    # Ann/bann membership: owning-span liveness over the slot array,
+    # exactly _gather_impl's scan (annotation rows are ringed, not
+    # paged; ring age IS their insertion order in both layouts).
+    live_r = row_gid >= 0
+    pos_r = jnp.clip(jnp.searchsorted(sorted_qids, trace_col), 0, nq - 1)
+    span_in = live_r & (sorted_qids[pos_r] == trace_col)
+    a_slot, a_live = _span_slot(ann_gid, row_gid, capacity)
+    ann_in = a_live & span_in[a_slot]
+    b_slot, b_live = _span_slot(bann_gid, row_gid, capacity)
+    bann_in = b_live & span_in[b_slot]
+
+    def oldest_k(mask, wp, cap, k):
+        head = (wp % cap).astype(jnp.int32)
+        slots = jnp.arange(cap, dtype=jnp.int32)
+        age = (slots - head) % jnp.int32(cap)
+        key = jnp.where(mask, jnp.int32(cap) - age, 0)
+        _, sel = jax.lax.top_k(key, k)
+        return sel
+
+    a_sel = oldest_k(ann_in, ann_write_pos, ann_capacity, k_anns)
+    ann_mat = jnp.stack([c[a_sel].astype(jnp.int64) for c in ann_cols])
+    ann_mat = jnp.where(ann_in[a_sel][None, :], ann_mat, -1)
+    b_sel = oldest_k(bann_in, bann_write_pos, bann_capacity, k_banns)
+    bann_mat = jnp.stack([c[b_sel].astype(jnp.int64) for c in bann_cols])
+    bann_mat = jnp.where(bann_in[b_sel][None, :], bann_mat, -1)
+    counts = jnp.stack([
+        ok.sum(dtype=jnp.int64),
+        ann_in.sum(dtype=jnp.int64),
+        bann_in.sum(dtype=jnp.int64),
+    ])
+    return counts, span_mat, ann_mat, bann_mat
+
+
+def gather_paged_trace_rows(
+    state: StoreState, sorted_qids, pages, epochs,
+    k_spans: int, k_anns: int, k_banns: int,
+):
+    """Paged twin of gather_trace_rows: span rows come from the page
+    list (Pallas block-gather when eligible, XLA take fallback — the
+    r12 arena_claim_scatter gating pattern), annotation rows from the
+    ring scan. Same four-array contract, so the host decode and
+    escalation paths are shared."""
+    from zipkin_tpu.ops import pallas_kernels as PK
+
+    c = state.config
+    use_pallas = PK.paged_gather_supported(
+        c.capacity, c.page_rows, len(SPAN_MAT_COLS),
+        len(pages),
+    ) and (c.use_pallas or jax.default_backend() == "tpu")
+    return _paged_gather_impl(
+        tuple(getattr(state, col) for col in SPAN_MAT_COLS),
+        tuple(getattr(state, col) for col in ANN_MAT_COLS),
+        tuple(getattr(state, col) for col in BANN_MAT_COLS),
+        sorted_qids,
+        jnp.asarray(pages, jnp.int32), jnp.asarray(epochs, jnp.int64),
+        state.ann_write_pos, state.bann_write_pos,
+        c.capacity, c.page_rows, c.ann_capacity, c.bann_capacity,
+        k_spans, k_anns, k_banns, use_pallas,
+    )
 
 
 
@@ -3486,7 +3725,7 @@ _QUERY_JITS = (
     _iq_multi_impl, _iq_service_impl, _iq_verify_impl,
     _iq_verify2_impl, _iq_durations_impl, _iq_gather_impl,
     _q_by_service_impl, _q_by_annotation_impl, _q_durations_impl,
-    _gather_impl, counter_block,
+    _gather_impl, _paged_gather_impl, counter_block,
 )
 
 

@@ -149,7 +149,15 @@ class SketchMirror:
     def delta_of(self, group) -> SketchDelta:
         """COO delta for one planned launch group (stage 1, host side):
         ``group`` is the ``_plan_units`` list of (SpanBatch, name_lc,
-        indexable) parts. Pure function — no lock, no device."""
+        indexable) parts. Pure function — no lock, no device.
+
+        LAYOUT-INDEPENDENT by contract: this reads batch CONTENT
+        columns only (ids, services, durations, annotations) — never
+        row placement (write_pos arithmetic, or the paged layout's
+        span_slot/span_gid planner columns), so ring and paged stores
+        fed the same stream build bitwise-equal mirrors.
+        tests/test_paged.py gates this (mirror arrays compared
+        element-for-element across layouts)."""
         c = self.config
         S = c.max_services
         hist_parts, svc_parts, name_parts, av_parts, bk_parts = (

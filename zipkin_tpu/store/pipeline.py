@@ -94,6 +94,11 @@ class IngestUnit(NamedTuple):
     # any shard's part carries, precomputed HOST-side in stage 1 —
     # ShardedStore.ingest requires it so the commit hold never syncs.
     incoming: Optional[int] = None
+    # Paged layout only (store/paged.PagePlanner): (lo, hi) gid ranges
+    # of the pages this unit reclaims — the commit stage pulls them
+    # through the eviction sink BEFORE the launch (per-page
+    # captured-before-overwrite). Empty for ring units.
+    reclaims: tuple = ()
 
 
 class _StageBase:

@@ -55,6 +55,7 @@ from zipkin_tpu.columnar.encode import to_signed64
 from zipkin_tpu.concurrency import RWLock
 from zipkin_tpu.store.analytics import WindowedAnalytics
 from zipkin_tpu.store.mirror import SketchMirror
+from zipkin_tpu.store.paged import PagePlanner
 from zipkin_tpu.testing.crash import kill_point
 from zipkin_tpu.store.base import (
     MAX_TTL_ENTRIES,
@@ -320,8 +321,19 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
                  codec: Optional[SpanCodec] = None,
                  registry=None):
         self.config = config or dev.StoreConfig()
+        if self.config.layout not in ("ring", "paged"):
+            raise ValueError(
+                f"unknown layout {self.config.layout!r} "
+                "(expected 'ring' or 'paged')")
         self.codec = codec or SpanCodec()
         self.state = dev.init_state(self.config)
+        # Paged layout (ISSUE 19): the host page allocator. Slot/gid
+        # assignment moves from the device's write_pos arithmetic to
+        # the planner's per-unit claim plan (stage 1 of the pipeline);
+        # the device kernels stay layout-blind because paged gids keep
+        # the ring invariant slot == gid % capacity (epoch-encoded).
+        self._planner = (PagePlanner(self.config)
+                         if self.config.paged_enabled else None)
         # Serializes writers against each other (queue workers).
         self._lock = threading.Lock()  # lock-order: 10 encode
         # Guards the state swap: ingest_step donates the old state's
@@ -481,6 +493,24 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
             fn=lambda: float(
                 self.config.window_seconds * self.config.window_buckets
                 if self.config.window_enabled else 0.0)))
+        # Paged-layout allocator occupancy (gauges read the planner's
+        # host mirrors under its own lock — zero device traffic).
+        if self._planner is not None:
+            planner = self._planner
+            reg.register(obs.Gauge(
+                "zipkin_store_pages_active",
+                "Device pages holding live spans (paged layout)",
+                fn=lambda: float(planner.stats()["pages_active"])))
+            reg.register(obs.Gauge(
+                "zipkin_store_pages_free",
+                "Device pages on the allocator free list (paged "
+                "layout)",
+                fn=lambda: float(planner.stats()["pages_free"])))
+            reg.register(obs.Counter(
+                "zipkin_store_page_reclaims_total",
+                "Pages captured + recycled through the free list "
+                "since process start (paged layout)",
+                fn=lambda: float(planner.stats()["page_reclaims"])))
         # The zipkin_store_counter family is registered by ApiServer
         # from the generic counters() hook (one registration site for
         # every backend), not here.
@@ -592,9 +622,17 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         append order equals feed order equals (FIFO) commit order."""
         stalled = 0.0
         for group in self._plan_units(parts):
-            unit = self._pad_unit(group)
-            if self.wal is not None:
-                unit = unit._replace(wal_seq=self._journal_group(group))
+            # Journal BEFORE padding: _pad_unit's page planning (paged
+            # layout) keys its claim plan to the unit's WAL sequence
+            # ATOMICALLY under the planner lock, so a checkpoint's
+            # planner snapshot can never see a plan without its seq
+            # (the replay memo's integrity). Dictionaries grew in the
+            # encode stage, so the journaled delta is pad-independent.
+            seq = (self._journal_group(group)
+                   if self.wal is not None else None)
+            unit = self._pad_unit(group, wal_seq=seq)
+            if seq is not None:
+                unit = unit._replace(wal_seq=seq)
                 kill_point("after-append")
             stalled += pipe.feed(unit)
         return stalled
@@ -630,8 +668,15 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         # reach the chunkers: a non-positive chunk size turns
         # _chunk_by_trace's split loop into an infinite empty-yield).
         limit = c.batch_spans if c.batch_spans > 0 else self.MAX_CHUNK
-        return max(1, min(limit, c.capacity // 2 or 1,
-                          c.pending_slots))
+        # Paged layout: one launch's page demand is bounded by its span
+        # count (<= ~4·spans/page_rows + 1 open pages), and every page
+        # claimed inside a unit is reclaim-exempt for that unit (the
+        # capture-before-reuse pull runs before the launch). capacity//8
+        # keeps the worst-case demand under half the pool, so the
+        # allocator always finds an untouched victim.
+        span_cap = (max(1, c.capacity // 8) if c.paged_enabled
+                    else c.capacity // 2 or 1)
+        return max(1, min(limit, span_cap, c.pending_slots))
 
     def _prune_ttls(self) -> None:
         prune_ttls(self.ttls, self.MAX_TTL_ENTRIES)
@@ -840,10 +885,15 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         by the time the donating swap runs, the group's record is in
         the log, so a crash between append and commit REPLAYS the
         group instead of losing it."""
-        unit = self._pad_unit(group)
+        seq = None
         if self.wal is not None:
             kill_point("before-append")
-            unit = unit._replace(wal_seq=self._journal_group(group))
+            seq = self._journal_group(group)
+        # Journal-before-pad: see _feed_units — the paged planner's
+        # claim plan is keyed to ``seq`` inside _pad_unit.
+        unit = self._pad_unit(group, wal_seq=seq)
+        if seq is not None:
+            unit = unit._replace(wal_seq=seq)
             kill_point("after-append")
         self._commit_unit(unit)
         kill_point("after-commit")
@@ -862,7 +912,9 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         uncaptured row up to exactly this bound). Yields part lists;
         singletons dispatch via ingest_step, larger groups chain
         through ingest_steps."""
-        span_budget = max(1, self.config.capacity // 2)
+        span_budget = (max(1, self.config.capacity // 8)
+                       if self.config.paged_enabled
+                       else max(1, self.config.capacity // 2))
         ann_budget = max(1, self.config.ann_capacity)
         bann_budget = max(1, self.config.bann_capacity)
         i = 0
@@ -885,7 +937,8 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
                 yield parts[i:i + 1]
             i += took
 
-    def _pad_unit(self, group) -> IngestUnit:
+    def _pad_unit(self, group, wal_seq: Optional[int] = None
+                  ) -> IngestUnit:
         """Pad one planned group to its pow2 buckets (host numpy — the
         H2D copy is the pipeline's stage 2, or implicit at dispatch on
         the serial path). Chained groups pad every chunk to the group
@@ -900,22 +953,43 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         from zipkin_tpu.aggregate import windows as win_mod
 
         sketch = self.sketch_mirror.delta_of(group)
+        # Paged layout: slot/gid claims are planned HERE — on the
+        # stage-1 caller thread, under the encode lock — so claim
+        # order equals feed order equals journal order (the planner's
+        # determinism contract). ``wal_seq`` is only passed by WAL
+        # replay, which re-reads recorded plans for already-planned
+        # sequences instead of re-deriving them.
+        plan = None
+        if self._planner is not None:
+            plan = self._planner.plan_unit(
+                [np.asarray(b.trace_id) for b, _, _ in group],
+                wal_seq=wal_seq)
         if self.config.window_enabled:
             ea, eb = win_mod.error_ids(self.dicts)
             err_of = lambda b: win_mod.span_error_flags(b, ea, eb)  # noqa: E731
         else:
             err_of = lambda b: None  # noqa: E731 — flag lowers out
+        pad_rc = 1
+        if plan is not None:
+            pad_rc = _next_pow2(max(
+                [1] + [len(c.reclaim_pages) for c in plan.chunks]))
         if len(group) == 1:
             b, lc, ix = group[0]
+            cp = plan.chunks[0] if plan is not None else None
             db = dev.make_device_batch(
                 b, name_lc_id=lc, indexable=ix,
                 pad_spans=_next_pow2(b.n_spans),
                 pad_anns=_next_pow2(b.n_annotations),
                 pad_banns=_next_pow2(b.n_binary),
                 error_flag=err_of(b),
+                span_slot=None if cp is None else cp.span_slot,
+                span_gid=None if cp is None else cp.span_gid,
+                reclaim_pages=None if cp is None else cp.reclaim_pages,
+                pad_reclaims=pad_rc,
             )
             return IngestUnit(db, b.n_spans, b.n_annotations,
-                              b.n_binary, 1, False, sketch=sketch)
+                              b.n_binary, 1, False, sketch=sketch,
+                              reclaims=plan.reclaims if plan else ())
         pad_s = _next_pow2(max(b.n_spans for b, _, _ in group))
         pad_a = _next_pow2(max(b.n_annotations for b, _, _ in group))
         pad_b = _next_pow2(max(b.n_binary for b, _, _ in group))
@@ -924,8 +998,15 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
                 b, name_lc_id=lc, indexable=ix,
                 pad_spans=pad_s, pad_anns=pad_a, pad_banns=pad_b,
                 error_flag=err_of(b),
+                span_slot=None if plan is None
+                else plan.chunks[ci].span_slot,
+                span_gid=None if plan is None
+                else plan.chunks[ci].span_gid,
+                reclaim_pages=None if plan is None
+                else plan.chunks[ci].reclaim_pages,
+                pad_reclaims=pad_rc,
             )
-            for b, lc, ix in group
+            for ci, (b, lc, ix) in enumerate(group)
         ]
         return IngestUnit(
             dev.stack_device_batches(dbs),
@@ -933,6 +1014,7 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
             sum(b.n_annotations for b, _, _ in group),
             sum(b.n_binary for b, _, _ in group),
             len(group), True, sketch=sketch,
+            reclaims=plan.reclaims if plan else (),
         )
 
     def _commit_unit(self, unit: IngestUnit) -> None:
@@ -944,7 +1026,17 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         the only device writer while a pipeline is active)."""
         self.ensure_writable()
         t0 = _time.perf_counter()
-        self._maybe_capture(unit.n_spans, unit.n_anns, unit.n_banns)
+        if self._planner is not None:
+            # Paged capture is at page granularity: the unit's plan
+            # names exactly the pages it reclaims, and their rows are
+            # pulled BEFORE the launch whose invalidation scatter
+            # erases them (the per-page captured-before-overwrite
+            # invariant). The ring-window trigger stays dormant — its
+            # [cap_upto, wp) arithmetic is FIFO-gid arithmetic.
+            if unit.reclaims:
+                self._capture_pages(unit.reclaims)
+        else:
+            self._maybe_capture(unit.n_spans, unit.n_anns, unit.n_banns)
         self._maybe_archive(unit.n_spans)
         step = dev.ingest_steps if unit.chained else dev.ingest_step
         # The host mirrors, the WAL applied frontier, and the cadence
@@ -1126,6 +1218,47 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         self._cap_upto, self._cap_a, self._cap_b = (
             self._wp, self._awp, self._bwp)
 
+    def _capture_pages(self, reclaims) -> None:
+        """Paged-layout eviction capture: pull each reclaimed page's
+        rows (one [lo, hi) = one page's gid range, hi - lo ==
+        page_rows) through the same pull/seal machinery as the ring
+        window, BEFORE the claiming unit's launch. Called on the
+        committing thread only (serial writer under self._lock, or the
+        pipeline's commit thread) — the same ordering position as
+        _maybe_capture.
+
+        The sealed frontier stays CONTIGUITY-gated: least-recently-
+        written reclaim hands back pages out of gid order, so the
+        frontier lags the newest sealed page until the older live
+        pages below it are themselves reclaimed — conservative by
+        design (a checkpoint cut never claims a live page's gids as
+        cold-durable; the saved ring state still holds those rows)."""
+        sink = self.eviction_sink
+        if sink is None:
+            return
+        c = self.config
+        with self._cap_lock:
+            for lo, hi in reclaims:
+                t0 = _time.perf_counter()
+                n_s, n_a, n_b, s_m, a_m, b_m = self._pull_evicted_rows(
+                    lo, hi, c.page_rows * 2, c.page_rows)
+                pull_s = _time.perf_counter() - t0
+                if self.capture_backlog and self.capture_backlog > 0:
+                    if self._sealer is None:
+                        self._sealer = EvictionSealer(
+                            self, backlog=self.capture_backlog,
+                            registry=self._registry)
+                    self._sealer.submit(n_s, n_a, n_b, s_m, a_m, b_m,
+                                        lo, hi, pull_s)
+                else:
+                    batch, gids = mats_to_batch(
+                        n_s, n_a, n_b,
+                        *jax.device_get((s_m, a_m, b_m)))
+                    kill_point("mid-seal")
+                    self.eviction_sink(batch, gids, lo, hi,
+                                       _time.perf_counter() - t0)
+                    self._note_sealed_locked(lo, hi)
+
     def _note_sealed(self, lo: int, hi: int) -> None:
         """Advance the sealed frontier — every gid below it is durable
         in the cold tier (called by the SEALER THREAD; the inline seal
@@ -1175,6 +1308,15 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         the cold tier current before a planned shutdown."""
         with self._lock:
             if self.eviction_sink is None:
+                return
+            if self._planner is not None:
+                # Paged stores capture at reclaim time only: every
+                # page handed back to the free list was sealed before
+                # reuse, and LIVE pages are never flushed early (their
+                # rows are still fully resident and queryable — there
+                # is no pending window to make current).
+                self.drain_pipeline()
+                self.seal_barrier()
                 return
             self.drain_pipeline()
             with self._cap_lock:
@@ -1243,6 +1385,14 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         # The adopted state's aggregates were built outside the write
         # path: resync the sketch mirror lazily from the device.
         self.sketch_mirror.mark_cold()
+        # Paged: the page table is a pure function of the resident
+        # rows — rebuild it from the adopted columns (partial pages
+        # stay closed; see PagePlanner.rebuild).
+        if self._planner is not None:
+            row_gid, trace_col = jax.device_get(
+                (self.state.row_gid, self.state.trace_id))
+            self._planner.rebuild(row_gid, trace_col,
+                                  wal_applied=self._wal_applied)
 
     # -- durable write-ahead log (zipkin_tpu.wal) -----------------------
 
@@ -1502,7 +1652,13 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
                      for t, ts, v in zip(mat[0], mat[1], mat[2]) if v]
             return cands, bool(complete), int(wm), mat.shape[1]
 
-        if self.config.use_index and not force_scan:
+        # Paged layout: the index read gates (wm < write_pos -
+        # capacity trust checks) are FIFO-gid arithmetic, unsound
+        # against epoch-encoded gids — id lookups take the exact
+        # O(ring) scan (index WRITES still run, keeping the lowering
+        # within one census table of the ring step).
+        if (self.config.use_index and not force_scan
+                and self._planner is None):
             return self._index_first(
                 limit, self.config.ann_capacity, index_fetch, fetch
             )
@@ -1558,7 +1714,8 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         # semantics); the index families are per-side, so the rare
         # mixed case takes the scan.
         mixed = ann_value >= 0 and bann_key >= 0
-        if c.use_index and not mixed and not force_scan:
+        if (c.use_index and not mixed and not force_scan
+                and self._planner is None):
             return self._index_first(
                 limit, c.ann_capacity + c.bann_capacity, index_fetch,
                 fetch,
@@ -1574,7 +1731,7 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         ann/binary names, and distrusted buckets drop to the singular
         paths. See SpanStore.get_trace_ids_multi for the query format."""
         c = self.config
-        if not c.use_index or not queries:
+        if not c.use_index or self._planner is not None or not queries:
             return super().get_trace_ids_multi(queries)
         results, probes, limits, fallback = resolve_multi_probes(
             c, self.dicts, queries
@@ -1634,7 +1791,8 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         """[4, nq] duration matrix: trace-membership fast path when its
         exactness gate holds, the full-ring scan otherwise."""
         with self._rw.read():
-            if self.config.use_index and not force_scan:
+            if (self.config.use_index and not force_scan
+                    and self._planner is None):
                 mat, exact = jax.device_get(
                     dev.iquery_durations(self.state, qids)
                 )
@@ -1659,8 +1817,11 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         with self._rw.read():
             st = self.state
             payload = None
-            if self.config.use_index and not force_scan:
+            if (self.config.use_index and not force_scan
+                    and self._planner is None):
                 payload = self._gather_via_index(st, qids)
+            if self._planner is not None and not force_scan:
+                payload = self._gather_via_pages(st, qids)
             if payload is None:
                 def fetch(k_s, k_a, k_b):
                     counts, s_m, a_m, b_m = jax.device_get(
@@ -1723,6 +1884,35 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         return decode_gathered(
             self.codec, n_s, n_a, n_b, span_mat, ann_mat, bann_mat
         )
+
+    def _gather_via_pages(self, st, qids: np.ndarray):
+        """Whole-trace gather over the queried traces' PAGE CHAINS —
+        the paged layout's answer to the index gather: the kernel
+        touches K·page_rows candidate rows (dev.gather_paged_trace_rows,
+        Pallas block-gather under the VMEM gate) instead of scanning
+        the full arena. Returns None when a chain overflowed
+        page_max_chain — those reads stay exact via the ring scan."""
+        chains = self._planner.chains_for(qids)
+        if chains is None:
+            return None
+        pages, epochs = chains
+        # Pad the page list to a pow2 bucket (hole pages = -1 produce
+        # zero rows) so steady-state reads hit compiled shapes only.
+        k = _next_pow2(max(1, len(pages)))
+        pg = np.full(k, -1, np.int32)
+        ep = np.zeros(k, np.int64)
+        pg[:len(pages)] = pages
+        ep[:len(epochs)] = epochs
+
+        def fetch(k_s, k_a, k_b):
+            counts, s_m, a_m, b_m = jax.device_get(
+                dev.gather_paged_trace_rows(st, qids, pg, ep,
+                                            k_s, k_a, k_b)
+            )
+            n_s, n_a, n_b = (int(x) for x in counts)
+            return n_s, n_a, n_b, (n_s, n_a, n_b, s_m, a_m, b_m)
+
+        return gather_with_escalation(self.config, fetch)
 
     def _gather_via_index(self, st, qids: np.ndarray):
         """Whole-trace gather through the trace-membership buckets (see
@@ -1980,10 +2170,19 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         from zipkin_tpu.columnar.schema import SpanBatch
 
         batch = SpanBatch.empty(0, 0, 0)
+        # Paged configs lower with planner-assigned slot/gid columns
+        # (shape [P]); synthesize empty ones so the traced shapes
+        # match what _pad_unit feeds the compiled step.
+        paged_cols = (
+            dict(span_slot=np.zeros(0, np.int32),
+                 span_gid=np.zeros(0, np.int64),
+                 reclaim_pages=np.zeros(0, np.int32))
+            if self.config.paged_enabled else {})
         db = dev.make_device_batch(
             batch, name_lc_id=np.zeros(0, np.int32),
             indexable=np.zeros(0, bool),
             pad_spans=n_spans, pad_anns=n_anns, pad_banns=n_banns,
+            **paged_cols,
         )
         with self._rw.read():
             text = dev.ingest_step.lower(self.state, db).as_text()
@@ -2023,6 +2222,13 @@ class TpuSpanStore(WindowedAnalytics, SpanStore):
         out["scatter_path_pallas"] = float(
             "pallas" in paths.get("scatter", ()))
         out["batch_spans_limit"] = float(self._max_chunk_spans())
+        # Paged-layout allocator occupancy (host mirrors — the same
+        # numbers the zipkin_store_pages_* gauges export).
+        if self._planner is not None:
+            pstats = self._planner.stats()
+            out["pages_active"] = float(pstats["pages_active"])
+            out["pages_free"] = float(pstats["pages_free"])
+            out["page_reclaims_total"] = float(pstats["page_reclaims"])
         # Windowed-arena fold accounting (host-monotonic mirror
         # counters — zero device traffic, like every read above).
         out["window_spans"] = float(self.sketch_mirror.win_spans_total)

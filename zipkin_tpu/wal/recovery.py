@@ -53,7 +53,11 @@ def apply_record_into(hot, seq: int, payload: bytes,
     Returns the unit's span count."""
     group, before, deltas = decode_unit(payload)
     apply_dict_deltas(hot.dicts, before, deltas)
-    unit = hot._pad_unit(group)._replace(wal_seq=seq)
+    # wal_seq threads into the pad so a paged store's planner can
+    # serve RECORDED page claims for sequences the checkpoint already
+    # planned (pipelined-save window) instead of re-planning them —
+    # the replay-equals-original bitwise contract for page layouts.
+    unit = hot._pad_unit(group, wal_seq=seq)._replace(wal_seq=seq)
     with hot._lock:
         for batch, _lc, _ix in group:
             for tid in np.unique(batch.trace_id):
